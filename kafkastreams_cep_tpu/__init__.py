@@ -1,0 +1,51 @@
+"""kafkastreams_cep_tpu — a TPU-native Complex Event Processing framework.
+
+A ground-up re-design of the capabilities of ``vaquarkhan/kafkastreams-cep``
+(the SASE+ NFA pattern-matching library for Kafka Streams) for TPU hardware:
+
+* a fluent pattern DSL (``Query``) mirroring the reference QueryBuilder
+  (reference: ``pattern/QueryBuilder.java``),
+* a pattern -> NFA compiler producing both a host stage graph and dense
+  transition tables (reference: ``pattern/StatesFactory.java``),
+* a faithful host *oracle* engine used for conformance
+  (reference: ``nfa/NFA.java``),
+* a batched JAX/XLA array engine (``engine.TPUMatcher``) that steps thousands
+  of per-key NFA instances per device under ``jit``/``vmap``/``shard_map``,
+* a host runtime (processor/topology/checkpoint) replacing the Kafka Streams
+  integration layer (reference: ``CEPProcessor.java``).
+"""
+
+from kafkastreams_cep_tpu.utils.events import Event, Sequence
+from kafkastreams_cep_tpu.nfa.dewey import DeweyVersion
+from kafkastreams_cep_tpu.pattern.query import Query, QueryBuilder
+from kafkastreams_cep_tpu.pattern.pattern import Pattern, Cardinality, SelectStrategy
+from kafkastreams_cep_tpu.pattern.predicate import Matcher, and_, or_, not_
+from kafkastreams_cep_tpu.compiler.stages import (
+    Stage,
+    StageType,
+    EdgeOperation,
+    compile_pattern,
+)
+from kafkastreams_cep_tpu.nfa.oracle import OracleNFA
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Event",
+    "Sequence",
+    "DeweyVersion",
+    "Query",
+    "QueryBuilder",
+    "Pattern",
+    "Cardinality",
+    "SelectStrategy",
+    "Matcher",
+    "and_",
+    "or_",
+    "not_",
+    "Stage",
+    "StageType",
+    "EdgeOperation",
+    "compile_pattern",
+    "OracleNFA",
+]

@@ -1,0 +1,9 @@
+from kafkastreams_cep_tpu.compiler.stages import (
+    Stage,
+    StageType,
+    Edge,
+    EdgeOperation,
+    compile_pattern,
+)
+
+__all__ = ["Stage", "StageType", "Edge", "EdgeOperation", "compile_pattern"]

@@ -1,0 +1,5 @@
+from kafkastreams_cep_tpu.nfa.dewey import DeweyVersion
+from kafkastreams_cep_tpu.nfa.buffer import SharedVersionedBuffer
+from kafkastreams_cep_tpu.nfa.oracle import OracleNFA
+
+__all__ = ["DeweyVersion", "SharedVersionedBuffer", "OracleNFA"]

@@ -1,0 +1,18 @@
+from kafkastreams_cep_tpu.pattern.pattern import Pattern, Cardinality, SelectStrategy
+from kafkastreams_cep_tpu.pattern.predicate import Matcher, and_, or_, not_, true_
+from kafkastreams_cep_tpu.pattern.aggregator import StateAggregator
+from kafkastreams_cep_tpu.pattern.query import Query, QueryBuilder
+
+__all__ = [
+    "Pattern",
+    "Cardinality",
+    "SelectStrategy",
+    "Matcher",
+    "and_",
+    "or_",
+    "not_",
+    "true_",
+    "StateAggregator",
+    "Query",
+    "QueryBuilder",
+]

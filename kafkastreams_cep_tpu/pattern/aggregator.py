@@ -1,0 +1,34 @@
+"""Fold-aggregate state declarations.
+
+The reference lets a stage register named fold functions
+``(key, value, current) -> new`` evaluated only when an event is consumed
+(``pattern/Aggregator.java:22-25``, ``nfa/NFA.java:248,260-265``), with the
+state scoped per run and copied on Kleene branching
+(``pattern/ValueStore.java:92-97``).
+
+Deviation from the reference (documented): the Java implementation starts a
+fresh run's fold state as ``null``; arrays cannot represent ``null``, so every
+fold must declare an ``init`` value (default ``0``).  ``states.get(name)``
+returns ``init`` until the first fold runs.  Patterns whose predicates only
+read state that an earlier stage's fold always sets (the common case, e.g. the
+SASE stock query) behave identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+AggregatorFn = Callable[[Any, Any, Any], Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class StateAggregator:
+    """A named fold: ``fn(key, value, current) -> new`` with initial value.
+
+    Mirrors ``pattern/StateAggregator.java:20-37`` plus the explicit ``init``.
+    """
+
+    name: str
+    fn: AggregatorFn
+    init: Any = 0

@@ -1,0 +1,98 @@
+"""Predicate algebra for pattern guards.
+
+A matcher is a function ``(key, value, timestamp, states) -> bool`` — the same
+signature as the reference's ``Matcher.matches`` (``pattern/Matcher.java:22``)
+— plus the combinators ``not_``/``and_``/``or_``
+(``pattern/Matcher.java:24-70``).
+
+Matchers must be written so they are **JAX-traceable**: the ``bool`` they
+return may be a traced ``jnp.bool_`` scalar when evaluated inside the array
+engine, and a plain Python bool when evaluated by the host oracle.  ``states``
+is a read-only view over the per-run fold state (see
+``pattern/aggregator.py``); inside the array engine its values are traced
+scalars.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+MatcherFn = Callable[[Any, Any, Any, Any], Any]
+
+
+class Matcher:
+    """A named, composable guard over ``(key, value, timestamp, states)``."""
+
+    __slots__ = ("fn", "label")
+
+    def __init__(self, fn: MatcherFn, label: Optional[str] = None):
+        if isinstance(fn, Matcher):
+            fn, label = fn.fn, label or fn.label
+        if not callable(fn):
+            raise TypeError(f"matcher must be callable, got {type(fn)!r}")
+        self.fn = fn
+        self.label = label or getattr(fn, "__name__", "matcher")
+
+    def __call__(self, key, value, timestamp, states):
+        return self.fn(key, value, timestamp, states)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Matcher({self.label})"
+
+
+def _wrap(m) -> Matcher:
+    return m if isinstance(m, Matcher) else Matcher(m)
+
+
+def _normalize(result):
+    """Coerce plain host values to bool; leave traced/array values alone.
+
+    Bitwise ``~``/``&``/``|`` are the only operators traced booleans support,
+    but they are wrong for plain truthy ints (``~1 == -2`` is truthy), so host
+    scalars are normalized to ``bool`` first.
+    """
+    if isinstance(result, bool):
+        return result
+    if isinstance(result, (int, float)) and not hasattr(result, "shape"):
+        return bool(result)
+    return result
+
+
+def not_(matcher) -> Matcher:
+    m = _wrap(matcher)
+
+    def fn(key, value, timestamp, states):
+        result = _normalize(m(key, value, timestamp, states))
+        return (not result) if isinstance(result, bool) else ~result
+
+    return Matcher(fn, label=f"not({m.label})")
+
+
+def and_(left, right) -> Matcher:
+    l, r = _wrap(left), _wrap(right)
+
+    def fn(key, value, timestamp, states):
+        lv = _normalize(l(key, value, timestamp, states))
+        rv = _normalize(r(key, value, timestamp, states))
+        if isinstance(lv, bool) and isinstance(rv, bool):
+            return lv and rv
+        return lv & rv
+
+    return Matcher(fn, label=f"and({l.label},{r.label})")
+
+
+def or_(left, right) -> Matcher:
+    l, r = _wrap(left), _wrap(right)
+
+    def fn(key, value, timestamp, states):
+        lv = _normalize(l(key, value, timestamp, states))
+        rv = _normalize(r(key, value, timestamp, states))
+        if isinstance(lv, bool) and isinstance(rv, bool):
+            return lv or rv
+        return lv | rv
+
+    return Matcher(fn, label=f"or({l.label},{r.label})")
+
+
+def true_() -> Matcher:
+    return Matcher(lambda key, value, timestamp, states: True, label="true")

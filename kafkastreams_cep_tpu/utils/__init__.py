@@ -1,0 +1,3 @@
+from kafkastreams_cep_tpu.utils.events import Event, Sequence
+
+__all__ = ["Event", "Sequence"]

@@ -1,0 +1,98 @@
+"""Shared versioned buffer goldens, ported from the reference
+``nfa/buffer/SharedVersionedBufferTest.java:28-68``."""
+
+import pytest
+
+from kafkastreams_cep_tpu import DeweyVersion, Event
+from kafkastreams_cep_tpu.compiler.stages import Stage, StageType
+from kafkastreams_cep_tpu.nfa.buffer import SharedVersionedBuffer
+
+EV1 = Event("k1", "v1", 1000000001, "topic-test", 0, 0)
+EV2 = Event("k2", "v2", 1000000002, "topic-test", 0, 1)
+EV3 = Event("k3", "v3", 1000000003, "topic-test", 0, 2)
+EV4 = Event("k4", "v4", 1000000004, "topic-test", 0, 3)
+EV5 = Event("k5", "v5", 1000000005, "topic-test", 0, 4)
+
+FIRST = Stage("first", StageType.BEGIN)
+SECOND = Stage("second", StageType.NORMAL)
+LATEST = Stage("latest", StageType.FINAL)
+
+
+def test_extract_patterns_with_one_run():
+    buffer = SharedVersionedBuffer()
+    buffer.put_first(FIRST, EV1, DeweyVersion("1"))
+    buffer.put(SECOND, EV2, FIRST, EV1, DeweyVersion("1.0"))
+    buffer.put(LATEST, EV3, SECOND, EV2, DeweyVersion("1.0.0"))
+
+    sequence = buffer.get(LATEST, EV3, DeweyVersion("1.0.0"))
+    assert sequence.size() == 3
+    assert sequence.get("latest") == [EV3]
+    assert sequence.get("second") == [EV2]
+    assert sequence.get("first") == [EV1]
+
+
+def test_extract_patterns_with_branching_run():
+    buffer = SharedVersionedBuffer()
+    buffer.put_first(FIRST, EV1, DeweyVersion("1"))
+    buffer.put(SECOND, EV2, FIRST, EV1, DeweyVersion("1.0"))
+    buffer.put(LATEST, EV3, SECOND, EV2, DeweyVersion("1.0.0"))
+
+    buffer.put(SECOND, EV3, SECOND, EV2, DeweyVersion("1.1"))
+    buffer.put(SECOND, EV4, SECOND, EV3, DeweyVersion("1.1"))
+    buffer.put(LATEST, EV5, SECOND, EV4, DeweyVersion("1.1.0"))
+
+    sequence1 = buffer.get(LATEST, EV3, DeweyVersion("1.0.0"))
+    assert sequence1.size() == 3
+    assert sequence1.get("latest") == [EV3]
+    assert sequence1.get("second") == [EV2]
+    assert sequence1.get("first") == [EV1]
+
+    sequence2 = buffer.get(LATEST, EV5, DeweyVersion("1.1.0"))
+    assert sequence2.size() == 5
+    assert len(sequence2.get("latest")) == 1
+    assert len(sequence2.get("second")) == 3
+    assert len(sequence2.get("first")) == 1
+
+
+def test_put_with_missing_predecessor_is_a_hard_error():
+    # KVSharedVersionedBuffer.java:86-89.
+    buffer = SharedVersionedBuffer()
+    with pytest.raises(RuntimeError):
+        buffer.put(SECOND, EV2, FIRST, EV1, DeweyVersion("1.0"))
+
+
+def test_remove_garbage_collects_unshared_path():
+    buffer = SharedVersionedBuffer()
+    buffer.put_first(FIRST, EV1, DeweyVersion("1"))
+    buffer.put(SECOND, EV2, FIRST, EV1, DeweyVersion("1.0"))
+    buffer.put(LATEST, EV3, SECOND, EV2, DeweyVersion("1.0.0"))
+
+    sequence = buffer.remove(LATEST, EV3, DeweyVersion("1.0.0"))
+    assert sequence.size() == 3
+    assert len(buffer) == 0
+
+
+def test_branch_protects_shared_prefix_from_removal():
+    buffer = SharedVersionedBuffer()
+    buffer.put_first(FIRST, EV1, DeweyVersion("1"))
+    buffer.put(SECOND, EV2, FIRST, EV1, DeweyVersion("1.0"))
+    # A sibling run branches off the shared prefix ev1<-ev2.
+    buffer.branch(SECOND, EV2, DeweyVersion("1.0"))
+    buffer.put(LATEST, EV3, SECOND, EV2, DeweyVersion("1.0.0"))
+
+    buffer.remove(LATEST, EV3, DeweyVersion("1.0.0"))
+    # The shared prefix survives for the sibling.
+    assert buffer.get(SECOND, EV2, DeweyVersion("1.1")).size() == 2
+
+
+def test_combinators_handle_plain_int_predicates():
+    from kafkastreams_cep_tpu import and_, not_, or_
+
+    int_true = lambda k, v, ts, st: 1
+    int_false = lambda k, v, ts, st: 0
+    args = (None, None, 0, None)
+    assert not_(int_true)(*args) is False
+    assert not_(int_false)(*args) is True
+    assert and_(int_true, int_true)(*args) is True
+    assert and_(int_true, int_false)(*args) is False
+    assert or_(int_false, int_true)(*args) is True

@@ -1,0 +1,149 @@
+"""Pattern-compiler structural goldens, hand-derived from
+``pattern/StatesFactory.java:41-127`` semantics."""
+
+from kafkastreams_cep_tpu import Query, compile_pattern
+from kafkastreams_cep_tpu.compiler.stages import EdgeOperation, Stage, StageType
+
+
+def value_is(expected):
+    return lambda k, v, ts, store: v == expected
+
+
+def strict_three_stage():
+    return (
+        Query()
+        .select("first").where(value_is("A"))
+        .then()
+        .select("second").where(value_is("B"))
+        .then()
+        .select("latest").where(value_is("C"))
+        .build()
+    )
+
+
+def test_strict_three_stage_structure():
+    stages = compile_pattern(strict_three_stage())
+    # Java order: [$final, latest, second, first(begin)] (StatesFactory.java:44-62).
+    assert [s.name for s in stages] == ["$final", "latest", "second", "first"]
+    assert [s.type for s in stages] == [
+        StageType.FINAL,
+        StageType.NORMAL,
+        StageType.NORMAL,
+        StageType.BEGIN,
+    ]
+    # Cardinality ONE => single BEGIN edge per stage, no IGNORE/PROCEED.
+    for stage in stages[1:]:
+        assert [e.op for e in stage.edges] == [EdgeOperation.BEGIN]
+    # Final stage has no edges.
+    assert stages[0].edges == []
+    # Edges chain to the successor.
+    assert stages[3].edges[0].target is stages[2]
+    assert stages[2].edges[0].target is stages[1]
+    assert stages[1].edges[0].target is stages[0]
+
+
+def test_one_or_more_adds_mandatory_state():
+    # ONE_OR_MORE prepends a same-named BEGIN-edge state; buildState returns
+    # the mandatory state, so the Kleene loop stage is reachable only through
+    # its edge target (StatesFactory.java:110-118).
+    query = (
+        Query()
+        .select("a").where(value_is("A"))
+        .then()
+        .select("b").one_or_more().where(value_is("B"))
+        .then()
+        .select("c").where(value_is("C"))
+        .build()
+    )
+    stages = compile_pattern(query)
+    assert [s.name for s in stages] == ["$final", "c", "b", "a"]
+    mandatory = stages[2]
+    assert mandatory.type is StageType.NORMAL
+    assert [e.op for e in mandatory.edges] == [EdgeOperation.BEGIN]
+    loop = mandatory.edges[0].target
+    assert loop.name == "b"
+    assert loop.type is StageType.NORMAL
+    assert [e.op for e in loop.edges] == [EdgeOperation.TAKE, EdgeOperation.PROCEED]
+    assert loop.edges[0].target.name == "c"
+    assert loop.edges[1].target.name == "c"
+
+
+def test_strategies_synthesize_ignore_edges():
+    q_any = (
+        Query()
+        .select("x").where(value_is("A"))
+        .then()
+        .select("y").zero_or_more().skip_till_any_match().where(value_is("B"))
+        .then()
+        .select("z").where(value_is("C"))
+        .build()
+    )
+    stages = compile_pattern(q_any)
+    y = stages[2]
+    assert [e.op for e in y.edges] == [
+        EdgeOperation.TAKE,
+        EdgeOperation.IGNORE,
+        EdgeOperation.PROCEED,
+    ]
+
+    q_next = (
+        Query()
+        .select("x").where(value_is("A"))
+        .then()
+        .select("y").skip_till_next_match().where(value_is("B"))
+        .build()
+    )
+    y2 = compile_pattern(q_next)[1]
+    # Cardinality ONE: BEGIN consuming edge + IGNORE, no PROCEED.
+    assert [e.op for e in y2.edges] == [EdgeOperation.BEGIN, EdgeOperation.IGNORE]
+
+
+def test_optional_and_zero_or_more_compile_identically():
+    # Quirk preserved from StatesFactory.java:70-81 (see SURVEY.md section 7).
+    def build(card):
+        sb = Query().select("x").where(value_is("A")).then().select("y")
+        sb = getattr(sb, card)()
+        return sb.where(value_is("B")).then().select("z").where(value_is("C")).build()
+
+    s_opt = compile_pattern(build("optional"))
+    s_zom = compile_pattern(build("zero_or_more"))
+    assert [s.name for s in s_opt] == [s.name for s in s_zom]
+    for a, b in zip(s_opt, s_zom):
+        assert [e.op for e in a.edges] == [e.op for e in b.edges]
+
+
+def test_window_is_pushed_and_inherited():
+    # Window inheritance from successor (StatesFactory.java:121-127).
+    query = (
+        Query()
+        .select("x").where(value_is("A"))
+        .then()
+        .select("y").where(value_is("B")).within(1, "h")
+        .build()
+    )
+    stages = compile_pattern(query)
+    y, x = stages[1], stages[2]
+    assert y.window_ms == 3_600_000
+    # x has no window of its own but inherits from its successor pattern y.
+    assert x.window_ms == 3_600_000
+
+
+def test_stage_equality_is_name_and_type():
+    # Stage.java:116-127; epsilon wrappers compare equal to their base stage.
+    base = Stage("s", StageType.NORMAL)
+    target = Stage("t", StageType.NORMAL)
+    eps = Stage.epsilon(base, target)
+    assert eps == base
+    assert hash(eps) == hash(base)
+    assert eps.is_epsilon()
+
+
+def test_first_stage_cannot_be_optional_or_zero_or_more():
+    import pytest
+
+    for card in ("optional", "zero_or_more"):
+        sb = Query().select("x")
+        sb = getattr(sb, card)()
+        query = sb.where(value_is("A")).then().select("y").where(value_is("B")).build()
+        with pytest.raises(ValueError):
+            compile_pattern(query)
