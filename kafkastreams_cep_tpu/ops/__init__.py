@@ -1,0 +1,1 @@
+"""Device-side array kernels (currently: fixed-width Dewey versions)."""

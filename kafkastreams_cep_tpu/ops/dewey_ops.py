@@ -1,0 +1,88 @@
+"""Fixed-width Dewey version kernels for the array engine.
+
+The host class (`nfa/dewey.py`) stores a variable-length tuple; the device
+representation is a fixed ``[D]`` int32 vector plus a scalar length, so every
+operation is a masked, jit-compatible array op.  Semantics match the
+reference's ``nfa/DeweyVersion.java``:
+
+* ``add_run``   increments the last live component (``DeweyVersion.java:51-56``);
+* ``add_stage`` appends a ``0`` component (``DeweyVersion.java:84-86``) —
+  unlike the host, the device width is bounded, so ``add_stage`` additionally
+  returns an ``overflow`` flag that is true when the version is already full
+  (the component is then dropped; callers surface the flag as an engine
+  counter).  Depth growth is unbounded in the reference: an inner-frame
+  IGNORE re-add appends a stage digit without advancing the run
+  (``NFA.java:186,223-227``), so any fixed width can overflow on adversarial
+  traces;
+* ``is_compatible(q, p)`` is true when ``p`` is a proper prefix of ``q``, or
+  both have equal length with an equal prefix and ``last(q) >= last(p)``
+  (``DeweyVersion.java:62-82``).
+
+All functions take and return plain ``jnp`` values and vmap cleanly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+Version = jnp.ndarray  # [D] int32
+Length = jnp.ndarray  # scalar int32
+
+
+def make(components, depth: int):
+    """Host helper: a ``(version, length)`` pair from an int tuple.
+
+    Returns numpy values (cheap on host; JAX converts at trace boundaries).
+    """
+    components = tuple(int(c) for c in components)
+    if len(components) > depth:
+        raise ValueError(f"version {components} deeper than D={depth}")
+    vec = np.zeros((depth,), dtype=np.int32)
+    vec[: len(components)] = components
+    return vec, np.int32(len(components))
+
+
+def to_tuple(ver, vlen):
+    """Host helper: back to the tuple form used by ``nfa.dewey.DeweyVersion``."""
+    return tuple(int(c) for c in ver[: int(vlen)])
+
+
+def add_run(ver: Version, vlen: Length) -> Version:
+    """Increment the last live component (length is unchanged)."""
+    idx = jnp.arange(ver.shape[0], dtype=jnp.int32)
+    return ver + jnp.where(idx == vlen - 1, 1, 0).astype(ver.dtype)
+
+
+def add_stage(ver: Version, vlen: Length):
+    """Append a ``0`` component; returns ``(ver, vlen, overflow)``.
+
+    On overflow (``vlen == D``) the version is returned unchanged and the
+    flag is set; the engine counts these and the run keeps its version — a
+    documented deviation from the reference's unbounded growth.
+    """
+    depth = ver.shape[0]
+    overflow = vlen >= depth
+    new_len = jnp.where(overflow, vlen, vlen + 1)
+    # Slots at index >= vlen are already zero by construction, so appending a
+    # zero needs no write; only the length moves.
+    return ver, new_len.astype(vlen.dtype), overflow
+
+
+def is_compatible(qver: Version, qlen: Length, pver: Version, plen: Length):
+    """``DeweyVersion.isCompatible`` over fixed-width vectors.
+
+    ``q`` is the query (receiver) version, ``p`` the pointer version — the
+    same argument order as ``qv.isCompatible(pv)`` in the reference
+    (``TimedKeyValue.java:91``).
+    """
+    idx = jnp.arange(qver.shape[0], dtype=jnp.int32)
+    eq = qver == pver
+    # all(q[:n] == p[:n]) for a dynamic n, via masking.
+    prefix_full = jnp.all(jnp.where(idx < plen, eq, True))
+    prefix_butlast = jnp.all(jnp.where(idx < plen - 1, eq, True))
+    last_q = jnp.sum(jnp.where(idx == plen - 1, qver, 0))
+    last_p = jnp.sum(jnp.where(idx == plen - 1, pver, 0))
+    longer = (qlen > plen) & prefix_full
+    equal = (qlen == plen) & prefix_butlast & (last_q >= last_p)
+    return longer | equal

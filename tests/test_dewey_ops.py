@@ -1,0 +1,95 @@
+"""Fixed-width Dewey kernels vs the host ``DeweyVersion`` algebra.
+
+Covers the reference truth table (``nfa/DeweyVersionTest.java:39-44``) plus an
+exhaustive differential sweep of ``is_compatible`` against the host class.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kafkastreams_cep_tpu import DeweyVersion
+from kafkastreams_cep_tpu.ops import dewey_ops
+
+D = 6
+
+
+def _pair(s: str):
+    return dewey_ops.make(DeweyVersion(s).components, D)
+
+
+def test_make_round_trip():
+    ver, vlen = _pair("1.0.1")
+    assert dewey_ops.to_tuple(ver, vlen) == (1, 0, 1)
+
+
+def test_add_run_matches_host():
+    for s in ["1", "1.0", "1.0.1", "2.3"]:
+        ver, vlen = _pair(s)
+        out = dewey_ops.add_run(ver, vlen)
+        assert dewey_ops.to_tuple(out, vlen) == DeweyVersion(s).add_run().components
+
+
+def test_add_stage_matches_host():
+    for s in ["1", "1.0", "1.0.1"]:
+        ver, vlen = _pair(s)
+        out_ver, out_len, overflow = dewey_ops.add_stage(ver, vlen)
+        assert not bool(overflow)
+        assert dewey_ops.to_tuple(out_ver, out_len) == DeweyVersion(s).add_stage().components
+
+
+def test_add_stage_overflow_keeps_version():
+    ver, vlen = dewey_ops.make((1, 0, 0, 0, 0, 0), D)
+    out_ver, out_len, overflow = dewey_ops.add_stage(ver, vlen)
+    assert bool(overflow)
+    assert int(out_len) == D
+    assert dewey_ops.to_tuple(out_ver, out_len) == (1, 0, 0, 0, 0, 0)
+
+
+def test_compatibility_truth_table():
+    # DeweyVersionTest.java:39-44.
+    cases = [
+        ("1.0", "2.0", False),
+        ("1.0.0", "1.0", True),
+        ("1.1", "1.0", True),
+        ("1.0", "1.1", False),
+        ("1.0", "1.0.0", False),
+    ]
+    fn = jax.jit(dewey_ops.is_compatible)
+    for q, p, expected in cases:
+        qv, ql = _pair(q)
+        pv, pl = _pair(p)
+        assert bool(fn(qv, ql, pv, pl)) == expected, (q, p)
+
+
+def test_compatibility_exhaustive_vs_host():
+    """Every version pair up to depth 3 with components in {1,2} ∪ {0 tail}."""
+    pool = []
+    for depth in (1, 2, 3):
+        for combo in itertools.product((0, 1, 2), repeat=depth):
+            if combo[0] == 0:
+                continue  # leading component is always >= 1 in practice
+            pool.append(combo)
+    pairs = list(itertools.product(pool, repeat=2))
+    host = [DeweyVersion(a).is_compatible(DeweyVersion(b)) for a, b in pairs]
+    qv = jnp.stack([dewey_ops.make(a, D)[0] for a, _ in pairs])
+    ql = jnp.asarray([len(a) for a, _ in pairs], dtype=jnp.int32)
+    pv = jnp.stack([dewey_ops.make(b, D)[0] for _, b in pairs])
+    pl = jnp.asarray([len(b) for _, b in pairs], dtype=jnp.int32)
+    out = jax.jit(jax.vmap(dewey_ops.is_compatible))(qv, ql, pv, pl)
+    assert out.tolist() == host
+
+
+def test_vmap_batch():
+    qs = jnp.stack([_pair("1.0.0")[0], _pair("1.1")[0], _pair("1.0")[0]])
+    qls = jnp.asarray([3, 2, 2], dtype=jnp.int32)
+    pv, pl = _pair("1.0")
+    out = jax.vmap(lambda v, l: dewey_ops.is_compatible(v, l, pv, pl))(qs, qls)
+    assert out.tolist() == [True, True, True]
+
+
+def test_make_rejects_too_deep():
+    with pytest.raises(ValueError):
+        dewey_ops.make((1,) * (D + 1), D)
