@@ -1,1 +1,2 @@
-"""Device-side array kernels (currently: fixed-width Dewey versions)."""
+"""Device-side array kernels: fixed-width Dewey versions (``dewey_ops``) and
+the slab shared versioned buffer (``slab``)."""

@@ -1,0 +1,323 @@
+"""Device shared versioned buffer — the SASE match DAG as a fixed slab.
+
+Array equivalent of the host dict buffer (``nfa/buffer.py``) and the
+reference ``nfa/buffer/impl/KVSharedVersionedBuffer.java``.  One slab holds
+the buffer for ONE key/partition; the engine ``vmap``s these functions over
+the key axis.
+
+Representation (``E`` entries × ``MP`` predecessor pointers × depth ``D``):
+
+* an *entry* is keyed by ``(stage, off)`` — the stage's canonical identity
+  position (``compiler/tables.py``) and the event offset, the array form of
+  ``StackEventKey`` (``StackEventKey.java:28-54``); ``stage == -1`` marks a
+  free slot;
+* each entry carries a refcount and an ordered list of Dewey-versioned
+  predecessor pointers (``TimedKeyValue.java:27-45``); a pointer with
+  ``pstage == -1`` is the null-predecessor run origin
+  (``KVSharedVersionedBuffer.java:117-128``).
+
+Semantics preserved exactly (differentially tested against the host buffer):
+
+* ``put`` requires the predecessor entry to exist — the reference throws
+  (``KVSharedVersionedBuffer.java:86-89``); under ``jit`` we count it in
+  ``missing`` and drop the write;
+* ``put_first`` overwrites unconditionally (``:117-128``);
+* walks select, at each hop, the **first** pointer (insertion order) whose
+  version is compatible with the walk version, then adopt that pointer's
+  version (``TimedKeyValue.java:83-92``);
+* refcount decrements floor at zero (``TimedKeyValue.java:59-61``); an entry
+  is deleted only when ``remove`` and ``refs == 0`` and it has at most one
+  predecessor; the traversed pointer is pruned when ``refs == 0``
+  (``KVSharedVersionedBuffer.java:147-171``);
+* capacity limits (slab full, pointer list full, walk bound) have no
+  reference analog; overflows are counted, never raised.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kafkastreams_cep_tpu.ops import dewey_ops
+
+
+class SlabState(NamedTuple):
+    stage: jnp.ndarray  # [E] int32 — identity stage position; -1 free
+    off: jnp.ndarray  # [E] int32 — event offset
+    refs: jnp.ndarray  # [E] int32
+    npreds: jnp.ndarray  # [E] int32
+    pstage: jnp.ndarray  # [E, MP] int32 — -1 = null pointer (run origin)
+    poff: jnp.ndarray  # [E, MP] int32
+    pver: jnp.ndarray  # [E, MP, D] int32
+    pvlen: jnp.ndarray  # [E, MP] int32
+    full_drops: jnp.ndarray  # scalar int32 — entry allocation failures
+    pred_drops: jnp.ndarray  # scalar int32 — pointer-list overflow drops
+    missing: jnp.ndarray  # scalar int32 — lookups the reference would NPE on
+    trunc: jnp.ndarray  # scalar int32 — walks cut short by the walk bound
+
+
+def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
+    E, MP, D = num_entries, max_preds, depth
+    i32 = jnp.int32
+    return SlabState(
+        stage=jnp.full((E,), -1, dtype=i32),
+        off=jnp.full((E,), -1, dtype=i32),
+        refs=jnp.zeros((E,), dtype=i32),
+        npreds=jnp.zeros((E,), dtype=i32),
+        pstage=jnp.full((E, MP), -1, dtype=i32),
+        poff=jnp.full((E, MP), -1, dtype=i32),
+        pver=jnp.zeros((E, MP, D), dtype=i32),
+        pvlen=jnp.zeros((E, MP), dtype=i32),
+        full_drops=jnp.zeros((), dtype=i32),
+        pred_drops=jnp.zeros((), dtype=i32),
+        missing=jnp.zeros((), dtype=i32),
+        trunc=jnp.zeros((), dtype=i32),
+    )
+
+
+def find(slab: SlabState, stage, off) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Entry index for ``(stage, off)`` and whether it exists."""
+    hit = (slab.stage == stage) & (slab.off == off)
+    return jnp.argmax(hit), jnp.any(hit)
+
+
+def _alloc(slab: SlabState):
+    free = slab.stage < 0
+    return jnp.argmax(free), jnp.any(free)
+
+
+def _select_pointer(slab: SlabState, e, qver, qlen):
+    """First version-compatible predecessor pointer of entry ``e``
+    (``TimedKeyValue.java:83-92``)."""
+    mp = slab.pstage.shape[1]
+    valid = jnp.arange(mp, dtype=jnp.int32) < slab.npreds[e]
+    compat = jax.vmap(dewey_ops.is_compatible, in_axes=(None, None, 0, 0))(
+        qver, qlen, slab.pver[e], slab.pvlen[e]
+    )
+    hit = compat & valid
+    return jnp.argmax(hit), jnp.any(hit)
+
+
+def _append_pointer(slab: SlabState, e, pstage, poff, ver, vlen, enable):
+    """Append a pointer to entry ``e``'s list; drops (counted) when full."""
+    mp = slab.pstage.shape[1]
+    n = slab.npreds[e]
+    full = n >= mp
+    do = enable & ~full
+    slot = jnp.minimum(n, mp - 1)
+
+    def upd(field, value):
+        return field.at[e, slot].set(jnp.where(do, value, field[e, slot]))
+
+    return slab._replace(
+        pstage=upd(slab.pstage, pstage),
+        poff=upd(slab.poff, poff),
+        pver=slab.pver.at[e, slot].set(jnp.where(do, ver, slab.pver[e, slot])),
+        pvlen=upd(slab.pvlen, vlen),
+        npreds=slab.npreds.at[e].add(jnp.where(do, 1, 0)),
+        pred_drops=slab.pred_drops + jnp.where(enable & full, 1, 0),
+    )
+
+
+def _prune_pointer(slab: SlabState, e, j, enable):
+    """Remove pointer ``j`` of entry ``e``, shifting later pointers left to
+    keep insertion order (``TimedKeyValue.removePredecessor``)."""
+    mp = slab.pstage.shape[1]
+    idx = jnp.arange(mp, dtype=jnp.int32)
+    src = jnp.where(idx >= j, jnp.minimum(idx + 1, mp - 1), idx)
+
+    def shift(field):
+        return jnp.where(enable, jnp.take(field, src, axis=0), field)
+
+    pstage_e = shift(slab.pstage[e])
+    poff_e = shift(slab.poff[e])
+    pvlen_e = shift(slab.pvlen[e])
+    pver_e = shift(slab.pver[e])
+    return slab._replace(
+        pstage=slab.pstage.at[e].set(pstage_e),
+        poff=slab.poff.at[e].set(poff_e),
+        pvlen=slab.pvlen.at[e].set(pvlen_e),
+        pver=slab.pver.at[e].set(pver_e),
+        npreds=slab.npreds.at[e].add(jnp.where(enable, -1, 0)),
+    )
+
+
+def put_first(slab: SlabState, stage, off, ver, vlen, enable=True) -> SlabState:
+    """First-stage put: fresh entry whose single null-predecessor pointer
+    records the run version; overwrites any existing entry
+    (``KVSharedVersionedBuffer.java:117-128``)."""
+    enable = jnp.asarray(enable)
+    existing, found = find(slab, stage, off)
+    free, has_free = _alloc(slab)
+    e = jnp.where(found, existing, free)
+    ok = enable & (found | has_free)
+
+    def set1(field, value):
+        return field.at[e].set(jnp.where(ok, value, field[e]))
+
+    slab = slab._replace(
+        stage=set1(slab.stage, stage),
+        off=set1(slab.off, off),
+        refs=set1(slab.refs, 1),
+        npreds=set1(slab.npreds, 0),
+        full_drops=slab.full_drops + jnp.where(enable & ~found & ~has_free, 1, 0),
+    )
+    return _append_pointer(slab, e, jnp.int32(-1), jnp.int32(-1), ver, vlen, ok)
+
+
+def put(slab: SlabState, cur_stage, cur_off, prev_stage, prev_off, ver, vlen, enable=True) -> SlabState:
+    """Append a versioned predecessor pointer to ``(cur_stage, cur_off)``.
+
+    The predecessor entry must exist (``KVSharedVersionedBuffer.java:86-89``);
+    a miss is counted and the write dropped.
+    """
+    enable = jnp.asarray(enable)
+    _, prev_found = find(slab, prev_stage, prev_off)
+    slab = slab._replace(missing=slab.missing + jnp.where(enable & ~prev_found, 1, 0))
+    enable = enable & prev_found
+
+    existing, found = find(slab, cur_stage, cur_off)
+    free, has_free = _alloc(slab)
+    e = jnp.where(found, existing, free)
+    create = enable & ~found & has_free
+    ok = enable & (found | has_free)
+
+    def init1(field, value):
+        return field.at[e].set(jnp.where(create, value, field[e]))
+
+    slab = slab._replace(
+        stage=init1(slab.stage, cur_stage),
+        off=init1(slab.off, cur_off),
+        refs=init1(slab.refs, 1),
+        npreds=init1(slab.npreds, 0),
+        full_drops=slab.full_drops + jnp.where(enable & ~found & ~has_free, 1, 0),
+    )
+    return _append_pointer(slab, e, prev_stage, prev_off, ver, vlen, ok)
+
+
+def branch(slab: SlabState, stage, off, ver, vlen, max_walk: int, enable=True) -> SlabState:
+    """Refcount-increment walk so shared prefixes survive sibling removal
+    (``KVSharedVersionedBuffer.java:99-110``)."""
+
+    def body(_, carry):
+        slab, stage, off, qver, qlen, active = carry
+        e, found = find(slab, stage, off)
+        slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
+        active = active & found
+        slab = slab._replace(refs=slab.refs.at[e].add(jnp.where(active, 1, 0)))
+        j, sel = _select_pointer(slab, e, qver, qlen)
+        active = active & sel & (slab.pstage[e, j] >= 0)
+        stage = jnp.where(active, slab.pstage[e, j], stage)
+        off = jnp.where(active, slab.poff[e, j], off)
+        qver = jnp.where(active, slab.pver[e, j], qver)
+        qlen = jnp.where(active, slab.pvlen[e, j], qlen)
+        return slab, stage, off, qver, qlen, active
+
+    init = (
+        slab,
+        jnp.asarray(stage, jnp.int32),
+        jnp.asarray(off, jnp.int32),
+        jnp.asarray(ver, jnp.int32),
+        jnp.asarray(vlen, jnp.int32),
+        jnp.asarray(enable),
+    )
+    out = jax.lax.fori_loop(0, max_walk, body, init)
+    slab, still_active = out[0], out[5]
+    # A walk still active after max_walk hops was truncated: refcounts along
+    # the untraversed tail were not incremented (no reference analog).
+    return slab._replace(trunc=slab.trunc + jnp.where(still_active, 1, 0))
+
+
+def peek(
+    slab: SlabState,
+    stage,
+    off,
+    ver,
+    vlen,
+    max_walk: int,
+    remove: bool,
+    enable=True,
+):
+    """Backward pointer walk assembling a match, final stage first.
+
+    Returns ``(slab, out_stage[max_walk], out_off[max_walk], count)``; hops
+    beyond the walk bound are dropped (no reference analog — counted via the
+    returned ``count`` saturating at ``max_walk``).  With ``remove`` this is
+    ``SharedVersionedBuffer.remove`` (refcount GC + pointer pruning);
+    without, ``get`` — which still decrements refcounts, a preserved quirk of
+    ``KVSharedVersionedBuffer.peek`` (``:156``).
+    """
+    L = max_walk
+    out_stage = jnp.full((L,), -1, dtype=jnp.int32)
+    out_off = jnp.full((L,), -1, dtype=jnp.int32)
+
+    def body(i, carry):
+        slab, stage, off, qver, qlen, active, out_stage, out_off, count = carry
+        e, found = find(slab, stage, off)
+        slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
+        active = active & found
+
+        refs_left = jnp.maximum(slab.refs[e] - 1, 0)  # floors at zero
+        slab = slab._replace(
+            refs=slab.refs.at[e].set(jnp.where(active, refs_left, slab.refs[e]))
+        )
+        delete = active & remove & (refs_left == 0) & (slab.npreds[e] <= 1)
+        slab = slab._replace(
+            stage=slab.stage.at[e].set(jnp.where(delete, -1, slab.stage[e])),
+            off=slab.off.at[e].set(jnp.where(delete, -1, slab.off[e])),
+        )
+
+        out_stage = out_stage.at[i].set(jnp.where(active, stage, out_stage[i]))
+        out_off = out_off.at[i].set(jnp.where(active, off, out_off[i]))
+        count = count + jnp.where(active, 1, 0)
+
+        j, sel = _select_pointer(slab, e, qver, qlen)
+        sel = sel & active
+        prune = sel & remove & (refs_left == 0)
+        nxt_stage = slab.pstage[e, j]
+        nxt_off = slab.poff[e, j]
+        nxt_ver = slab.pver[e, j]
+        nxt_len = slab.pvlen[e, j]
+        slab = _prune_pointer(slab, e, j, prune)
+
+        active = sel & (nxt_stage >= 0)
+        stage = jnp.where(active, nxt_stage, stage)
+        off = jnp.where(active, nxt_off, off)
+        qver = jnp.where(active, nxt_ver, qver)
+        qlen = jnp.where(active, nxt_len, qlen)
+        return slab, stage, off, qver, qlen, active, out_stage, out_off, count
+
+    init = (
+        slab,
+        jnp.asarray(stage, jnp.int32),
+        jnp.asarray(off, jnp.int32),
+        jnp.asarray(ver, jnp.int32),
+        jnp.asarray(vlen, jnp.int32),
+        jnp.asarray(enable),
+        out_stage,
+        out_off,
+        jnp.zeros((), dtype=jnp.int32),
+    )
+    slab, _, _, _, _, still_active, out_stage, out_off, count = jax.lax.fori_loop(
+        0, L, body, init
+    )
+    # Truncated extraction: the untraversed tail keeps its refcounts (a leak
+    # the caller can see via this counter) and the returned hops are partial.
+    slab = slab._replace(trunc=slab.trunc + jnp.where(still_active, 1, 0))
+    return slab, out_stage, out_off, count
+
+
+def live_entries(slab: SlabState) -> jnp.ndarray:
+    """Number of occupied slots (host/diagnostic helper)."""
+    return jnp.sum(slab.stage >= 0)
+
+
+# Eager per-op dispatch is orders of magnitude slower than compiled code on
+# this host; the public entry points are jitted (the engine additionally
+# inlines them under its own jit, where these wrappers are free).
+put_first = jax.jit(put_first)
+put = jax.jit(put)
+branch = jax.jit(branch, static_argnames=("max_walk",))
+peek = jax.jit(peek, static_argnames=("max_walk", "remove"))

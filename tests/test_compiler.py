@@ -2,11 +2,8 @@
 ``pattern/StatesFactory.java:41-127`` semantics."""
 
 from kafkastreams_cep_tpu import Query, compile_pattern
+from conftest import value_is
 from kafkastreams_cep_tpu.compiler.stages import EdgeOperation, Stage, StageType
-
-
-def value_is(expected):
-    return lambda k, v, ts, store: v == expected
 
 
 def strict_three_stage():

@@ -7,6 +7,7 @@ import time
 from typing import List
 
 from kafkastreams_cep_tpu import Event, OracleNFA, Query, Sequence
+from conftest import value_is
 
 NOW = int(time.time() * 1000)
 
@@ -32,10 +33,6 @@ def simulate(nfa: OracleNFA, *events: Event) -> List[Sequence]:
             )
         )
     return out
-
-
-def value_is(expected):
-    return lambda k, v, ts, store: v == expected
 
 
 def test_one_run_strict_contiguity():

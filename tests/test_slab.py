@@ -1,0 +1,316 @@
+"""Device slab buffer vs the host shared versioned buffer.
+
+Part 1 ports the reference buffer goldens
+(``nfa/buffer/SharedVersionedBufferTest.java:28-68``) onto raw slab ops.
+Part 2 mirrors every buffer call made by real oracle runs (the five golden
+scenarios) into a slab and checks stores and extraction outputs stay
+identical after every operation.
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import numpy as np
+
+from kafkastreams_cep_tpu import DeweyVersion, Event, OracleNFA, Query
+from conftest import value_is
+from kafkastreams_cep_tpu.compiler.stages import compile_pattern
+from kafkastreams_cep_tpu.nfa.buffer import SharedVersionedBuffer
+from kafkastreams_cep_tpu.ops import dewey_ops, slab
+
+D = 8
+E = 32
+MP = 4
+WALK = 16
+
+FIRST, SECOND, LATEST = 0, 1, 2
+
+
+def ver(s: str):
+    return dewey_ops.make(DeweyVersion(s).components, D)
+
+
+def test_extract_patterns_with_one_run():
+    s = slab.make(E, MP, D)
+    s = slab.put_first(s, FIRST, 0, *ver("1"))
+    s = slab.put(s, SECOND, 1, FIRST, 0, *ver("1.0"))
+    s = slab.put(s, LATEST, 2, SECOND, 1, *ver("1.0.0"))
+    s, st, off, n = slab.peek(s, LATEST, 2, *ver("1.0.0"), max_walk=WALK, remove=False)
+    assert int(n) == 3
+    assert st[:3].tolist() == [LATEST, SECOND, FIRST]
+    assert off[:3].tolist() == [2, 1, 0]
+    assert int(s.missing) == 0
+
+
+def test_extract_patterns_with_branching_run():
+    s = slab.make(E, MP, D)
+    s = slab.put_first(s, FIRST, 0, *ver("1"))
+    s = slab.put(s, SECOND, 1, FIRST, 0, *ver("1.0"))
+    s = slab.put(s, LATEST, 2, SECOND, 1, *ver("1.0.0"))
+    s = slab.put(s, SECOND, 2, SECOND, 1, *ver("1.1"))
+    s = slab.put(s, SECOND, 3, SECOND, 2, *ver("1.1"))
+    s = slab.put(s, LATEST, 4, SECOND, 3, *ver("1.1.0"))
+
+    s, st, off, n = slab.peek(s, LATEST, 2, *ver("1.0.0"), max_walk=WALK, remove=False)
+    assert int(n) == 3
+    assert st[:3].tolist() == [LATEST, SECOND, FIRST]
+
+    s, st, off, n = slab.peek(s, LATEST, 4, *ver("1.1.0"), max_walk=WALK, remove=False)
+    assert int(n) == 5
+    assert st[:5].tolist() == [LATEST, SECOND, SECOND, SECOND, FIRST]
+    assert off[:5].tolist() == [4, 3, 2, 1, 0]
+
+
+def test_put_with_missing_predecessor_counts():
+    # The reference throws (KVSharedVersionedBuffer.java:86-89); under jit the
+    # slab counts and drops.
+    s = slab.make(E, MP, D)
+    s = slab.put(s, SECOND, 1, FIRST, 0, *ver("1.0"))
+    assert int(s.missing) == 1
+    assert int(slab.live_entries(s)) == 0
+
+
+def test_remove_garbage_collects_unshared_path():
+    s = slab.make(E, MP, D)
+    s = slab.put_first(s, FIRST, 0, *ver("1"))
+    s = slab.put(s, SECOND, 1, FIRST, 0, *ver("1.0"))
+    s = slab.put(s, LATEST, 2, SECOND, 1, *ver("1.0.0"))
+    s, _, _, n = slab.peek(s, LATEST, 2, *ver("1.0.0"), max_walk=WALK, remove=True)
+    assert int(n) == 3
+    assert int(slab.live_entries(s)) == 0
+
+
+def test_branch_protects_shared_prefix_from_removal():
+    s = slab.make(E, MP, D)
+    s = slab.put_first(s, FIRST, 0, *ver("1"))
+    s = slab.put(s, SECOND, 1, FIRST, 0, *ver("1.0"))
+    s = slab.branch(s, SECOND, 1, *ver("1.0"), max_walk=WALK)
+    s = slab.put(s, LATEST, 2, SECOND, 1, *ver("1.0.0"))
+    s, _, _, _ = slab.peek(s, LATEST, 2, *ver("1.0.0"), max_walk=WALK, remove=True)
+    s, st, off, n = slab.peek(s, SECOND, 1, *ver("1.1"), max_walk=WALK, remove=False)
+    assert int(n) == 2
+    assert st[:2].tolist() == [SECOND, FIRST]
+
+
+def test_walk_bound_truncation_counts():
+    # A 4-hop chain walked with max_walk=2 must flag the truncation.
+    s = slab.make(E, MP, D)
+    s = slab.put_first(s, FIRST, 0, *ver("1"))
+    s = slab.put(s, SECOND, 1, FIRST, 0, *ver("1.0"))
+    s = slab.put(s, SECOND, 2, SECOND, 1, *ver("1.0"))
+    s = slab.put(s, LATEST, 3, SECOND, 2, *ver("1.0.0"))
+    s2 = slab.branch(s, LATEST, 3, *ver("1.0.0"), max_walk=2)
+    assert int(s2.trunc) == 1
+    s3, _, _, n = slab.peek(s, LATEST, 3, *ver("1.0.0"), max_walk=2, remove=True)
+    assert int(n) == 2 and int(s3.trunc) == 1
+    # A full-length walk is not flagged.
+    s4, _, _, n = slab.peek(s, LATEST, 3, *ver("1.0.0"), max_walk=WALK, remove=False)
+    assert int(n) == 4 and int(s4.trunc) == 0
+
+
+def test_slab_full_counts_drop():
+    s = slab.make(2, MP, D)
+    s = slab.put_first(s, FIRST, 0, *ver("1"))
+    s = slab.put(s, SECOND, 1, FIRST, 0, *ver("1.0"))
+    s = slab.put(s, LATEST, 2, SECOND, 1, *ver("1.0.0"))  # no slot left
+    assert int(s.full_drops) == 1
+
+
+# ---------------------------------------------------------------------------
+# Differential: mirror every oracle buffer call into a slab.
+# ---------------------------------------------------------------------------
+
+
+class MirroredBuffer(SharedVersionedBuffer):
+    """Host buffer that replays every call onto a slab and cross-checks."""
+
+    def __init__(self):
+        super().__init__()
+        self.slab = slab.make(E, MP, D)
+        self.stage_ids: Dict[Tuple[str, str], int] = {}
+        self.offsets: Dict[Tuple[str, int, int], int] = {}
+
+    def _sid(self, stage) -> int:
+        key = (stage.name, stage.type.value)
+        return self.stage_ids.setdefault(key, len(self.stage_ids))
+
+    def _off(self, event: Event) -> int:
+        return self.offsets.setdefault(event.position, len(self.offsets))
+
+    def _ver(self, version: DeweyVersion):
+        return dewey_ops.make(version.components, D)
+
+    def put_first(self, stage, event, version):
+        super().put_first(stage, event, version)
+        self.slab = slab.put_first(self.slab, self._sid(stage), self._off(event), *self._ver(version))
+        self.check()
+
+    def put(self, curr_stage, curr_event, prev_stage, prev_event, version):
+        super().put(curr_stage, curr_event, prev_stage, prev_event, version)
+        self.slab = slab.put(
+            self.slab,
+            self._sid(curr_stage),
+            self._off(curr_event),
+            self._sid(prev_stage),
+            self._off(prev_event),
+            *self._ver(version),
+        )
+        self.check()
+
+    def branch(self, stage, event, version):
+        super().branch(stage, event, version)
+        self.slab = slab.branch(
+            self.slab, self._sid(stage), self._off(event), *self._ver(version), max_walk=WALK
+        )
+        self.check()
+
+    def _peek(self, stage, event, version, remove):
+        sequence = super()._peek(stage, event, version, remove)
+        self.slab, st, off, n = slab.peek(
+            self.slab,
+            self._sid(stage),
+            self._off(event),
+            *self._ver(version),
+            max_walk=WALK,
+            remove=remove,
+        )
+        # Same hop count and same per-stage event groups in walk order.
+        st, off, n = jax.device_get((st, off, n))
+        assert int(n) == sequence.size(), "walk length diverged"
+        by_name = {name: [] for name in sequence.stages()}
+        names = {v: k for k, v in self.stage_ids.items()}
+        offs = {v: k for k, v in self.offsets.items()}
+        for i in range(int(n)):
+            name = names[int(st[i])][0]
+            by_name.setdefault(name, []).append(offs[int(off[i])])
+        host = {
+            name: [e.position for e in events]
+            for name, events in sequence.as_map().items()
+        }
+        assert by_name == host, "extraction diverged"
+        self.check()
+        return sequence
+
+    def check(self):
+        """Slab store must equal the host dict store exactly."""
+        s = jax.device_get(self.slab)  # one transfer; numpy thereafter
+        live = {
+            (int(s.stage[i]), int(s.off[i])): i for i in np.flatnonzero(s.stage >= 0)
+        }
+        host_keys = {
+            (self.stage_ids[(k[0], k[1])], self.offsets[(k[2], k[3], k[4])])
+            for k in self.store
+        }
+        assert set(live) == host_keys, "live entries diverged"
+        for key, entry in self.store.items():
+            sid = self.stage_ids[(key[0], key[1])]
+            off = self.offsets[(key[2], key[3], key[4])]
+            i = live[(sid, off)]
+            assert int(s.refs[i]) == entry.refs, "refcount diverged"
+            assert int(s.npreds[i]) == len(entry.preds), "npreds diverged"
+            for m, pointer in enumerate(entry.preds):
+                assert (
+                    dewey_ops.to_tuple(s.pver[i, m], s.pvlen[i, m])
+                    == pointer.version.components
+                ), "pointer version diverged"
+                if pointer.key is None:
+                    assert int(s.pstage[i, m]) == -1
+                else:
+                    pk = pointer.key
+                    assert int(s.pstage[i, m]) == self.stage_ids[(pk[0], pk[1])]
+                    assert int(s.poff[i, m]) == self.offsets[(pk[2], pk[3], pk[4])]
+        assert int(s.missing) == 0
+        assert int(s.full_drops) == 0
+        assert int(s.pred_drops) == 0
+        assert int(s.trunc) == 0
+
+
+def _run_mirrored(query, values):
+    nfa = OracleNFA(compile_pattern(query), buffer=MirroredBuffer())
+    out = []
+    for i, v in enumerate(values):
+        out.extend(nfa.match(None, v, 1000 + i, offset=i))
+    return out
+
+
+def test_mirrored_strict_contiguity():
+    query = (
+        Query()
+        .select("first").where(value_is("A"))
+        .then()
+        .select("second").where(value_is("B"))
+        .then()
+        .select("latest").where(value_is("C"))
+        .build()
+    )
+    matches = _run_mirrored(query, ["A", "B", "C", "A", "X", "A", "B", "C"])
+    assert len(matches) == 2
+
+
+def test_mirrored_one_or_more():
+    query = (
+        Query()
+        .select("a").where(value_is("A"))
+        .then()
+        .select("b").one_or_more().where(value_is("B"))
+        .then()
+        .select("c").where(value_is("C"))
+        .build()
+    )
+    matches = _run_mirrored(query, ["A", "B", "B", "C", "A", "B", "C"])
+    assert len(matches) == 2
+
+
+def test_mirrored_skip_till_any_branches():
+    query = (
+        Query()
+        .select("first").where(value_is("A"))
+        .then()
+        .select("second").where(value_is("B"))
+        .then()
+        .select("three").skip_till_any_match().where(value_is("C"))
+        .then()
+        .select("latest").skip_till_any_match().where(value_is("D"))
+        .build()
+    )
+    matches = _run_mirrored(query, ["A", "B", "C", "C", "D"])
+    assert len(matches) == 2
+
+
+def test_mirrored_stock_query():
+    class Stock:
+        def __init__(self, price, volume):
+            self.price = price
+            self.volume = volume
+
+    query = (
+        Query()
+        .select()
+        .where(lambda k, v, ts, store: v.volume > 1000)
+        .fold("avg", lambda k, v, curr: v.price)
+        .then()
+        .select()
+        .zero_or_more()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, store: v.price > store.get("avg"))
+        .fold("avg", lambda k, v, curr: (curr + v.price) // 2)
+        .fold("volume", lambda k, v, curr: v.volume)
+        .then()
+        .select()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, store: v.volume < 0.8 * store.get_or_else("volume", 0))
+        .within(1, "h")
+        .build()
+    )
+    stocks = [
+        Stock(100, 1010),
+        Stock(120, 990),
+        Stock(120, 1005),
+        Stock(121, 999),
+        Stock(120, 999),
+        Stock(125, 750),
+        Stock(120, 950),
+        Stock(120, 700),
+    ]
+    matches = _run_mirrored(query, stocks)
+    assert len(matches) == 4

@@ -1,0 +1,195 @@
+"""Dense transition-table goldens for the five conformance scenarios.
+
+Each test hand-derives the exact arrays the lowering must emit from the
+``StatesFactory`` semantics (see ``compiler/stages.py`` goldens); the array
+engine consumes these tables, so their shape is load-bearing.
+"""
+
+import numpy as np
+
+from kafkastreams_cep_tpu import Query
+from conftest import value_is
+from kafkastreams_cep_tpu.compiler.tables import (
+    OP_BEGIN,
+    OP_NONE,
+    OP_TAKE,
+    TYPE_BEGIN,
+    TYPE_FINAL,
+    TYPE_NORMAL,
+    lower,
+)
+
+
+def strict_three_stage():
+    return (
+        Query()
+        .select("first").where(value_is("A"))
+        .then()
+        .select("second").where(value_is("B"))
+        .then()
+        .select("latest").where(value_is("C"))
+        .build()
+    )
+
+
+def test_strict_three_stage_tables():
+    t = lower(strict_three_stage())
+    assert t.names == ["first", "second", "latest", "$final"]
+    assert t.types.tolist() == [TYPE_BEGIN, TYPE_NORMAL, TYPE_NORMAL, TYPE_FINAL]
+    assert t.ident.tolist() == [0, 1, 2, 3]
+    assert t.consume_op.tolist() == [OP_BEGIN, OP_BEGIN, OP_BEGIN, OP_NONE]
+    assert t.consume_pred.tolist() == [0, 1, 2, -1]
+    assert t.consume_target.tolist() == [1, 2, 3, -1]
+    assert t.ignore_pred.tolist() == [-1, -1, -1, -1]
+    assert t.proceed_pred.tolist() == [-1, -1, -1, -1]
+    assert t.begin_pos == 0 and t.final_pos == 3
+    assert t.max_hops == 1
+    assert not t.can_branch
+    assert t.is_strict_seq()
+    assert t.num_predicates == 3 and t.num_states == 0
+
+
+def test_one_or_more_tables():
+    query = (
+        Query()
+        .select("a").where(value_is("A"))
+        .then()
+        .select("b").one_or_more().where(value_is("B"))
+        .then()
+        .select("c").where(value_is("C"))
+        .build()
+    )
+    t = lower(query)
+    # The Kleene loop stage is edge-only in the compiled list but must get a
+    # position: [a, b(mandatory), b(loop), c, $final].
+    assert t.names == ["a", "b", "b", "c", "$final"]
+    assert t.types.tolist() == [TYPE_BEGIN, TYPE_NORMAL, TYPE_NORMAL, TYPE_NORMAL, TYPE_FINAL]
+    # mandatory and loop stage share the (name, type) identity.
+    assert t.ident.tolist() == [0, 1, 1, 3, 4]
+    assert t.consume_op.tolist() == [OP_BEGIN, OP_BEGIN, OP_TAKE, OP_BEGIN, OP_NONE]
+    # TAKE successors self-loop: consume_target is the stage's own position.
+    assert t.consume_target.tolist() == [1, 2, 2, 4, -1]
+    # The mandatory BEGIN edge and the loop TAKE edge share one predicate object.
+    assert t.consume_pred.tolist() == [0, 1, 1, 3, -1]
+    assert t.proceed_pred.tolist() == [-1, -1, 2, -1, -1]
+    assert t.proceed_target.tolist() == [-1, -1, 3, -1, -1]
+    assert t.ignore_pred.tolist() == [-1, -1, -1, -1, -1]
+    assert t.max_hops == 2  # loop -> c
+    assert t.can_branch  # TAKE+PROCEED at the loop stage
+    assert not t.is_strict_seq()
+
+
+def test_skip_till_next_tables():
+    query = (
+        Query()
+        .select("first").where(value_is("A"))
+        .then()
+        .select("second").skip_till_next_match().where(value_is("C"))
+        .then()
+        .select("latest").skip_till_next_match().where(value_is("D"))
+        .build()
+    )
+    t = lower(query)
+    assert t.names == ["first", "second", "latest", "$final"]
+    assert t.consume_op.tolist() == [OP_BEGIN, OP_BEGIN, OP_BEGIN, OP_NONE]
+    # Predicate ids in first-use order: A, C, not(C), D, not(D).
+    assert t.consume_pred.tolist() == [0, 1, 3, -1]
+    assert t.ignore_pred.tolist() == [-1, 2, 4, -1]
+    assert [t.predicates[i].label for i in (2, 4)] == ["not(<lambda>)", "not(<lambda>)"]
+    assert t.proceed_pred.tolist() == [-1, -1, -1, -1]
+    assert t.max_hops == 1
+    assert t.can_branch
+
+
+def test_skip_till_any_tables():
+    query = (
+        Query()
+        .select("first").where(value_is("A"))
+        .then()
+        .select("second").where(value_is("B"))
+        .then()
+        .select("three").skip_till_any_match().where(value_is("C"))
+        .then()
+        .select("latest").skip_till_any_match().where(value_is("D"))
+        .build()
+    )
+    t = lower(query)
+    assert t.names == ["first", "second", "three", "latest", "$final"]
+    assert t.consume_op.tolist() == [OP_BEGIN] * 4 + [OP_NONE]
+    assert t.consume_pred.tolist() == [0, 1, 2, 4, -1]
+    # skip_till_any IGNORE guards are always-true matchers (distinct objects).
+    assert t.ignore_pred.tolist() == [-1, -1, 3, 5, -1]
+    assert t.predicates[3].label == "true" and t.predicates[5].label == "true"
+    assert t.can_branch
+
+
+def test_stock_query_tables():
+    query = (
+        Query()
+        .select()
+        .where(lambda k, v, ts, store: v["volume"] > 1000)
+        .fold("avg", lambda k, v, curr: v["price"])
+        .then()
+        .select()
+        .zero_or_more()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, store: v["price"] > store.get("avg"))
+        .fold("avg", lambda k, v, curr: (curr + v["price"]) // 2)
+        .fold("volume", lambda k, v, curr: v["volume"])
+        .then()
+        .select()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, store: v["volume"] < 0.8 * store.get_or_else("volume", 0))
+        .within(1, "h")
+        .build()
+    )
+    t = lower(query)
+    # Unnamed stages default to level numbers (Pattern.java:160-162).
+    assert t.names == ["0", "1", "2", "$final"]
+    assert t.types.tolist() == [TYPE_BEGIN, TYPE_NORMAL, TYPE_NORMAL, TYPE_FINAL]
+    # zero_or_more compiles to TAKE with no mandatory state (OPTIONAL quirk).
+    assert t.consume_op.tolist() == [OP_BEGIN, OP_TAKE, OP_BEGIN, OP_NONE]
+    assert t.consume_target.tolist() == [1, 1, 3, -1]
+    # Predicates: p0, take1, not(take1), proceed-guard, p2, not(p2).
+    assert t.consume_pred.tolist() == [0, 1, 4, -1]
+    assert t.ignore_pred.tolist() == [-1, 2, 5, -1]
+    assert t.proceed_pred.tolist() == [-1, 3, -1, -1]
+    assert t.proceed_target.tolist() == [-1, 2, -1, -1]
+    # Window: stage 2 declares 1h; stage 1 inherits from its successor
+    # pattern; stage 0's successor pattern declares none -> -1.
+    assert t.window_ms.tolist() == [-1, 3_600_000, 3_600_000, -1]
+    # Fold state: avg first (stage 0), then volume (stage 1).
+    assert t.state_names == ["avg", "volume"]
+    assert [(a.stage, a.state, a.name) for a in t.aggs] == [
+        (0, 0, "avg"),
+        (1, 0, "avg"),
+        (1, 1, "volume"),
+    ]
+    mask = t.agg_masks()
+    assert mask.shape == (3, 4)
+    assert mask[:, 0].tolist() == [True, False, False]
+    assert mask[:, 1].tolist() == [False, True, True]
+    assert t.max_hops == 2
+    assert t.can_branch
+    assert not t.is_strict_seq()
+
+
+def test_one_or_more_multiple_kleene_hops():
+    # Two consecutive Kleene stages chain PROCEED edges: max_hops grows.
+    query = (
+        Query()
+        .select("a").where(value_is("A"))
+        .then()
+        .select("b").one_or_more().where(value_is("B"))
+        .then()
+        .select("c").one_or_more().where(value_is("C"))
+        .then()
+        .select("d").where(value_is("D"))
+        .build()
+    )
+    t = lower(query)
+    assert t.names == ["a", "b", "b", "c", "c", "d", "$final"]
+    assert t.ident.tolist() == [0, 1, 1, 3, 3, 5, 6]
+    # b-loop PROCEED -> c-mandatory (BEGIN, no proceed) => 2 frames;
+    # c-loop PROCEED -> d => 2 frames.
+    assert t.max_hops == 2
