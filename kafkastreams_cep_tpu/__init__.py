@@ -9,10 +9,9 @@ A ground-up re-design of the capabilities of ``vaquarkhan/kafkastreams-cep``
   transition tables (reference: ``pattern/StatesFactory.java``),
 * a faithful host *oracle* engine used for conformance
   (reference: ``nfa/NFA.java``),
-* a batched JAX/XLA array engine (``engine.TPUMatcher``) that steps thousands
-  of per-key NFA instances per device under ``jit``/``vmap``/``shard_map``,
-* a host runtime (processor/topology/checkpoint) replacing the Kafka Streams
-  integration layer (reference: ``CEPProcessor.java``).
+* a batched JAX/XLA array engine (``engine.TPUMatcher``) that steps the NFA
+  over fixed-shape run/buffer state under ``jit``, vmapping over key lanes,
+  differentially tested against the oracle (``tests/test_engine_*.py``).
 """
 
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
@@ -27,8 +26,13 @@ from kafkastreams_cep_tpu.compiler.stages import (
     compile_pattern,
 )
 from kafkastreams_cep_tpu.nfa.oracle import OracleNFA
+from kafkastreams_cep_tpu.engine.matcher import (
+    EngineConfig,
+    MatcherSession,
+    TPUMatcher,
+)
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Event",
@@ -48,4 +52,7 @@ __all__ = [
     "EdgeOperation",
     "compile_pattern",
     "OracleNFA",
+    "EngineConfig",
+    "MatcherSession",
+    "TPUMatcher",
 ]

@@ -119,11 +119,11 @@ class TransitionTables:
     def is_strict_seq(self) -> bool:
         """True for the branch-free fragment (all cardinality ONE, strict
         contiguity, no folds) that the data-parallel stencil matcher handles."""
+        # can_branch already covers any IGNORE edge, so no separate clause.
         return (
             not self.can_branch
             and not self.aggs
             and not np.any(self.consume_op == OP_TAKE)
-            and not np.any(self.ignore_pred >= 0)
         )
 
 
@@ -226,8 +226,12 @@ def lower(pattern_or_stages) -> TransitionTables:
                     # Deviation (shared with the oracle): begin-stage IGNORE
                     # edges are subsumed by the begin re-seed.
                     continue
+                if ignore_pred[i] != -1:
+                    raise ValueError(f"stage {stage.name!r}: multiple IGNORE edges")
                 ignore_pred[i] = pred_id(edge.matcher)
             elif edge.op is EdgeOperation.PROCEED:
+                if proceed_pred[i] != -1:
+                    raise ValueError(f"stage {stage.name!r}: multiple PROCEED edges")
                 proceed_pred[i] = pred_id(edge.matcher)
                 proceed_target[i] = pos[id(edge.target)]
 

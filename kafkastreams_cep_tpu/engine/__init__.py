@@ -1,0 +1,21 @@
+"""Device NFA engine: the batched array matcher and its session wrapper."""
+
+from kafkastreams_cep_tpu.engine.matcher import (
+    ArrayStates,
+    EngineConfig,
+    EngineState,
+    EventBatch,
+    MatcherSession,
+    StepOutput,
+    TPUMatcher,
+)
+
+__all__ = [
+    "ArrayStates",
+    "EngineConfig",
+    "EngineState",
+    "EventBatch",
+    "MatcherSession",
+    "StepOutput",
+    "TPUMatcher",
+]

@@ -1,0 +1,673 @@
+"""The TPU array NFA engine — batched, jittable SASE+ matching.
+
+This is the device counterpart of the host oracle (``nfa/oracle.py``) and the
+reason this project exists: the per-event evaluator of the reference
+(``nfa/NFA.java:94-289``) re-expressed as fixed-shape masked array programs so
+it jits, vmaps over keys, and shards over a TPU mesh.
+
+Representation
+--------------
+The run queue (``NFA.java:75``, a ``LinkedBlockingQueue``) becomes ``R`` fixed
+run slots.  Every queued run in the reference is either the *seed* run (the
+non-epsilon BEGIN stage re-added every event, ``NFA.java:148-157``) or an
+epsilon wrapper ``eps(identity, target)`` (``Stage.java:42-46``), so a run slot
+stores:
+
+* ``id_pos``    — canonical identity position of the wrapper (``-1`` = seed,
+  i.e. ``previous == null`` in ``NFA.evaluate``);
+* ``eval_pos``  — the wrapper's PROCEED target, where edge evaluation happens;
+* ``ver/vlen``  — fixed-width Dewey version (``ops/dewey_ops.py``);
+* ``event_off`` — pointer-event offset (``ComputationStage.getEvent``);
+* ``start_ts``  — window start; ``branching`` — the branch flag
+  (``ComputationStage.java:91-97``);
+* ``agg``       — per-run fold state.  Fold state can live *per slot* because
+  at any time each live run has a distinct sequence id: branch runs and
+  re-seeds always take fresh ids, and one run yields at most one same-id
+  successor per event (a frame either recurses on PROCEED or emits its one
+  local successor).
+
+Per-event step (semantics matched to ``NFA.java:162-250``)
+----------------------------------------------------------
+1. all predicates are evaluated for every run against its pre-event fold
+   state — exact because within one event all predicate evaluations happen
+   before all folds (folds run on recursion unwind, ``NFA.java:248``), and
+   runs never share fold state;
+2. each run walks its PROCEED chain, statically unrolled to the pattern's
+   ``max_hops``: masked BEGIN/TAKE/PROCEED/IGNORE dispatch, the 4-pair
+   branching rule (``NFA.java:280-289``), stage-digit appends on non-branching
+   stage crossings (``NFA.java:185-188``), producing at most one survivor,
+   one branch run per frame, and the seed re-add;
+3. folds apply innermost-frame-first (the unwind order), with branch-time
+   fold-state copies capturing exactly the reference's
+   copy-before-current-frame's-fold semantics (``NFA.java:243,248``);
+4. shared-buffer mutations (``ops/slab.py``) run sequentially in the
+   reference's op order: per run in queue order — consuming puts in frame
+   order, then branch walks deepest-first, then dead-run removal — and match
+   extraction for final states after all runs (``NFA.java:102-123``);
+5. survivors/branches/re-seeds are compacted into the next queue in exactly
+   the order the reference appends them; overflow beyond ``R`` is counted,
+   never silent.
+
+Windows: the reference's epsilon wrappers never carry ``windowMs``
+(``Stage.newEpsilonState``, ``Stage.java:41-46``), and every non-seed run is
+an epsilon wrapper, so ``isOutOfWindow`` (``ComputationStage.java:98-100``)
+can never fire — ``within()`` does not prune in the reference.  The engine
+reproduces that faithfully by default; ``EngineConfig.enforce_windows=True``
+opts into functional pruning using the evaluation stage's window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafkastreams_cep_tpu.compiler.tables import (
+    OP_BEGIN,
+    OP_TAKE,
+    TYPE_BEGIN,
+    TransitionTables,
+    lower,
+)
+from kafkastreams_cep_tpu.ops import dewey_ops
+from kafkastreams_cep_tpu.ops import slab as slab_mod
+from kafkastreams_cep_tpu.pattern.pattern import Pattern
+from kafkastreams_cep_tpu.utils.events import Event, Sequence
+
+
+class ArrayStates:
+    """Read-only fold-state view handed to predicates on device.
+
+    Mirrors ``pattern/States.java:46-68``; values are traced scalars.  Unlike
+    the host view, state is always "present" (initialized to the declared
+    ``init``), so ``get_or_else`` only falls back for unknown names.
+    """
+
+    __slots__ = ("_values",)
+
+    def __init__(self, values: Dict[str, Any]):
+        self._values = values
+
+    def get(self, name: str):
+        return self._values[name]
+
+    def get_or_else(self, name: str, default):
+        if name in self._values:
+            return self._values[name]
+        return default
+
+    def __getitem__(self, name: str):
+        return self.get(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static shape/feature knobs for one compiled matcher."""
+
+    max_runs: int = 16  # R — run-queue slots (overflow counted in run_drops)
+    slab_entries: int = 64  # E — shared-buffer slots per key
+    slab_preds: int = 8  # MP — predecessor pointers per buffer entry
+    dewey_depth: int = 12  # D — fixed Dewey width (overflow counted)
+    max_walk: int = 16  # W — buffer walk bound = max match length
+    enforce_windows: bool = False  # deviation: functional within() pruning
+
+
+class EventBatch(NamedTuple):
+    """One event (or a [T]-stacked batch) for a single key lane.
+
+    ``value`` is an arbitrary pytree of numeric scalars — the same object the
+    predicates receive.  ``valid`` masks padding steps.
+    """
+
+    key: jnp.ndarray
+    value: Any
+    ts: jnp.ndarray
+    off: jnp.ndarray
+    valid: jnp.ndarray
+
+
+class EngineState(NamedTuple):
+    """Full per-key engine state (run queue + slab + counters)."""
+
+    alive: jnp.ndarray  # [R] bool
+    id_pos: jnp.ndarray  # [R] int32 — -1 = seed run
+    eval_pos: jnp.ndarray  # [R] int32
+    ver: jnp.ndarray  # [R, D] int32
+    vlen: jnp.ndarray  # [R] int32
+    event_off: jnp.ndarray  # [R] int32 — -1 = none
+    start_ts: jnp.ndarray  # [R] int32
+    branching: jnp.ndarray  # [R] bool
+    agg: jnp.ndarray  # [R, NS] float32
+    slab: slab_mod.SlabState
+    run_drops: jnp.ndarray  # scalar int32 — queue-overflow drops
+    ver_overflows: jnp.ndarray  # scalar int32 — Dewey add_stage overflows
+
+
+class StepOutput(NamedTuple):
+    """Matches completed by one event, in emission order.
+
+    ``stage[r, w]``/``off[r, w]`` hold the backward buffer walk of run slot
+    ``r``'s match (final stage first, like ``Sequence`` insertion order);
+    ``count[r]`` is 0 for slots that completed nothing.
+    """
+
+    stage: jnp.ndarray  # [R, W] int32 — identity positions
+    off: jnp.ndarray  # [R, W] int32 — event offsets
+    count: jnp.ndarray  # [R] int32
+
+
+def _as_bool(x) -> jnp.ndarray:
+    return jnp.asarray(x, dtype=bool).reshape(())
+
+
+class _ChainRecord(NamedTuple):
+    """Everything one run's chain produced, consumed by the slab pass."""
+
+    surv_alive: jnp.ndarray
+    surv_final: jnp.ndarray
+    surv_id: jnp.ndarray
+    surv_eval: jnp.ndarray
+    surv_ver: jnp.ndarray
+    surv_vlen: jnp.ndarray
+    surv_event: jnp.ndarray
+    surv_start: jnp.ndarray
+    surv_branching: jnp.ndarray
+    put_en: jnp.ndarray  # [H]
+    put_cur: jnp.ndarray  # [H]
+    put_prev: jnp.ndarray  # [H] — -1 = put_first
+    put_ver: jnp.ndarray  # [H, D]
+    put_vlen: jnp.ndarray  # [H]
+    br_en: jnp.ndarray  # [H]
+    br_prev: jnp.ndarray  # [H] — walk origin stage
+    br_ver: jnp.ndarray  # [H, D] — walk version (pre-add_run)
+    br_vlen: jnp.ndarray  # [H]
+    br_run_ver: jnp.ndarray  # [H, D] — branch-run version (add_run)
+    br_run_vlen: jnp.ndarray  # [H]
+    br_id: jnp.ndarray  # [H] — branch-run identity (= prev)
+    br_eval: jnp.ndarray  # [H] — branch-run eval (= frame stage)
+    br_event: jnp.ndarray  # [H]
+    br_start: jnp.ndarray  # [H]
+    br_agg: jnp.ndarray  # [H, NS]
+    final_agg: jnp.ndarray  # [NS] — survivor fold state (all folds applied)
+    has_succ: jnp.ndarray
+    dead: jnp.ndarray
+    ovf: jnp.ndarray  # int32 — Dewey overflows in this chain
+
+
+def _build_step(tables: TransitionTables, cfg: EngineConfig):
+    """Compile the per-event step for one pattern — a pure jittable fn."""
+    R, D, W = cfg.max_runs, cfg.dewey_depth, cfg.max_walk
+    H = tables.max_hops
+    NS = max(tables.num_states, 1)
+    S_CAND = 1 + H + 1  # survivor, branch per hop, re-seed
+
+    ident = jnp.asarray(tables.ident)
+    types = jnp.asarray(tables.types)
+    consume_op = jnp.asarray(tables.consume_op)
+    consume_pred = jnp.asarray(tables.consume_pred)
+    consume_target = jnp.asarray(tables.consume_target)
+    ignore_pred = jnp.asarray(tables.ignore_pred)
+    proceed_pred = jnp.asarray(tables.proceed_pred)
+    proceed_target = jnp.asarray(tables.proceed_target)
+    window_ms = jnp.asarray(tables.window_ms.astype(np.int32))
+    final_pos = int(tables.final_pos)
+    begin_pos = int(tables.begin_pos)
+    predicates = tables.predicates
+    state_names = tables.state_names
+    inits = jnp.asarray(
+        [float(x) for x in tables.state_inits] or [0.0], dtype=jnp.float32
+    )
+    aggs = tables.aggs
+
+    def eval_preds(key, value, ts, agg_row):
+        states = ArrayStates({n: agg_row[i] for i, n in enumerate(state_names)})
+        vals = [_as_bool(p(key, value, ts, states)) for p in predicates]
+        return jnp.stack(vals)
+
+    def pv(preds, pid):
+        """Predicate value by id; ``-1`` (absent edge) is False."""
+        return jnp.where(pid >= 0, preds[jnp.maximum(pid, 0)], False)
+
+    def chain_one(
+        alive, id_pos, eval_pos, ver, vlen, event_off, start_ts0, branching, agg,
+        preds, key, value, ts, off,
+    ) -> _ChainRecord:
+        """One run's full evaluation chain (``NFA.evaluate``, recursion
+        unrolled to the pattern depth)."""
+        i32 = jnp.int32
+        seed = id_pos < 0
+        idc = jnp.maximum(id_pos, 0)
+        # getFirstPatternTimestamp (NFA.java:347-349): BEGIN-typed runs reset
+        # the window start to the current event's timestamp.
+        id_type_begin = seed | (types[idc] == TYPE_BEGIN)
+        start = jnp.where(id_type_begin, ts, start_ts0)
+
+        if cfg.enforce_windows:
+            w = window_ms[eval_pos]
+            out_w = (~id_type_begin) & (w != -1) & (ts - start_ts0 > w)
+        else:
+            # Faithful: epsilon wrappers carry windowMs == -1
+            # (Stage.java:41-46), so no run is ever out of window.
+            out_w = jnp.bool_(False)
+        active = alive & ~out_w
+
+        # Epsilon-hop stage digit (NFA.java:185-188): crossing into a new
+        # stage off a non-branching run appends ".0".  A branching run never
+        # appends (its flag survives the whole chain because setVersion — the
+        # only thing that clears it — is itself gated on not-branching).
+        cross0 = ident[eval_pos] != idc
+        do_add0 = active & ~seed & cross0 & ~branching
+        _, vlen_a, ovf0 = dewey_ops.add_stage(ver, vlen)
+        vl = jnp.where(do_add0, vlen_a, vlen)
+        vv = ver
+        ovf = jnp.where(do_add0 & ovf0, 1, 0).astype(i32)
+
+        cur = eval_pos
+        prev = jnp.where(seed, i32(-1), id_pos)
+
+        zero_ver = jnp.zeros((D,), i32)
+        surv_alive = jnp.bool_(False)
+        surv_final = jnp.bool_(False)
+        surv_id = i32(0)
+        surv_eval = i32(0)
+        surv_ver = zero_ver
+        surv_vlen = i32(0)
+        surv_event = i32(0)
+        surv_start = i32(0)
+        surv_branching = jnp.bool_(False)
+
+        put_en, put_cur, put_prev, put_ver, put_vlen = [], [], [], [], []
+        br_en, br_prev, br_ver, br_vlen = [], [], [], []
+        br_run_ver, br_run_vlen, br_id, br_eval, br_event, br_start = [], [], [], [], [], []
+        consumed_h, frame_pos = [], []
+
+        for _h in range(H):
+            cs = jnp.maximum(cur, 0)
+            cop = consume_op[cs]
+            cp = pv(preds, consume_pred[cs])
+            take_m = active & (cop == OP_TAKE) & cp
+            begin_m = active & (cop == OP_BEGIN) & cp
+            ig_m = active & pv(preds, ignore_pred[cs])
+            pr_m = active & pv(preds, proceed_pred[cs])
+            # The 4-pair nondeterministic branching rule (NFA.java:280-289).
+            branch_m = (pr_m & take_m) | (ig_m & take_m) | (ig_m & begin_m) | (ig_m & pr_m)
+            branch_m = branch_m & (prev >= 0)  # unreachable for seeds; guard
+            consumed = take_m | begin_m
+
+            # Survivor: at most one across the chain — a frame either
+            # recurses on PROCEED or emits its single local successor.
+            st = take_m & ~branch_m  # self-loop re-add (NFA.java:196-205)
+            sb = begin_m  # advance (NFA.java:210-222), kept even when branching
+            si = ig_m & ~branch_m  # unchanged re-add (NFA.java:223-227)
+            fire = st | sb | si
+            tgt = consume_target[cs]
+            surv_id = jnp.where(fire, jnp.where(si, id_pos, ident[cs]), surv_id)
+            surv_eval = jnp.where(
+                fire, jnp.where(st, cs, jnp.where(sb, tgt, eval_pos)), surv_eval
+            )
+            surv_ver = jnp.where(fire, vv, surv_ver)
+            surv_vlen = jnp.where(fire, vl, surv_vlen)
+            surv_event = jnp.where(fire, jnp.where(si, event_off, off), surv_event)
+            surv_start = jnp.where(fire, jnp.where(si, start_ts0, start), surv_start)
+            surv_branching = jnp.where(fire, si & branching, surv_branching)
+            surv_final = jnp.where(fire, sb & (tgt == final_pos), surv_final)
+            surv_alive = surv_alive | fire
+
+            # Consuming put; on a branching TAKE the event is recorded under
+            # the bumped version and no successor is emitted (NFA.java:206-208).
+            put_en.append(consumed)
+            put_cur.append(ident[cs])
+            put_prev.append(jnp.where(prev >= 0, ident[jnp.maximum(prev, 0)], i32(-1)))
+            put_ver.append(jnp.where(take_m & branch_m, dewey_ops.add_run(vv, vl), vv))
+            put_vlen.append(vl)
+
+            # Branch run (NFA.java:231-246): eps(previous, current), version
+            # addRun, pointer event = previous when the frame also ignored.
+            br_en.append(branch_m)
+            br_prev.append(ident[jnp.maximum(prev, 0)])
+            br_ver.append(vv)
+            br_vlen.append(vl)
+            br_run_ver.append(dewey_ops.add_run(vv, vl))
+            br_run_vlen.append(vl)
+            br_id.append(ident[jnp.maximum(prev, 0)])
+            br_eval.append(cs)
+            br_event.append(jnp.where(ig_m, event_off, off))
+            br_start.append(start)
+            consumed_h.append(consumed)
+            frame_pos.append(cs)
+
+            # PROCEED recursion (NFA.java:182-190).
+            ptgt = proceed_target[cs]
+            ptc = jnp.maximum(ptgt, 0)
+            do_add = pr_m & (ident[ptc] != ident[cs]) & ~branching
+            _, vlen_b, ovf_b = dewey_ops.add_stage(vv, vl)
+            vl = jnp.where(do_add, vlen_b, vl)
+            ovf = ovf + jnp.where(do_add & ovf_b, 1, 0).astype(i32)
+            prev = jnp.where(pr_m, cs, prev)
+            cur = jnp.where(pr_m, ptc, cur)
+            active = pr_m
+
+        # Fold pass, innermost frame first (folds run on recursion unwind,
+        # NFA.java:248); branch-time copies capture the state *before* the
+        # branching frame's own fold but *after* deeper frames'
+        # (NFA.java:243 runs before :248), restricted to the states declared
+        # at the branching stage (ValueStore.branch copies only those).
+        s = agg
+        br_agg: List[Any] = [None] * H
+        for h in range(H - 1, -1, -1):
+            copy_mask = jnp.zeros((NS,), bool)
+            for slot in aggs:
+                copy_mask = copy_mask.at[slot.state].set(
+                    copy_mask[slot.state] | (frame_pos[h] == slot.stage)
+                )
+            br_agg[h] = jnp.where(copy_mask, s, inits)
+            for slot in aggs:
+                cond = consumed_h[h] & (frame_pos[h] == slot.stage)
+                val = jnp.asarray(slot.fn(key, value, s[slot.state]), jnp.float32)
+                s = s.at[slot.state].set(jnp.where(cond, val, s[slot.state]))
+        final_agg = s
+
+        any_br = jnp.any(jnp.stack(br_en)) if H else jnp.bool_(False)
+        has_succ = surv_alive | any_br
+        dead = alive & ~seed & ~has_succ
+
+        stk = jnp.stack
+        return _ChainRecord(
+            surv_alive, surv_final, surv_id, surv_eval, surv_ver, surv_vlen,
+            surv_event, surv_start, surv_branching,
+            stk(put_en), stk(put_cur), stk(put_prev), stk(put_ver), stk(put_vlen),
+            stk(br_en), stk(br_prev), stk(br_ver), stk(br_vlen),
+            stk(br_run_ver), stk(br_run_vlen), stk(br_id), stk(br_eval),
+            stk(br_event), stk(br_start),
+            stk(br_agg), final_agg, has_succ, dead, ovf,
+        )
+
+    def step(state: EngineState, ev: EventBatch) -> Tuple[EngineState, StepOutput]:
+        i32 = jnp.int32
+        key, value, ts, off = ev.key, ev.value, jnp.asarray(ev.ts, i32), jnp.asarray(ev.off, i32)
+        valid = _as_bool(ev.valid)
+
+        preds = jax.vmap(lambda a: eval_preds(key, value, ts, a))(state.agg)  # [R, P]
+        rec: _ChainRecord = jax.vmap(
+            chain_one,
+            in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None, None),
+        )(
+            state.alive, state.id_pos, state.eval_pos, state.ver, state.vlen,
+            state.event_off, state.start_ts, state.branching, state.agg,
+            preds, key, value, ts, off,
+        )
+
+        # --- Shared-buffer mutations, in the reference's exact op order:
+        # per run (queue order): consuming puts frame-by-frame, branch walks
+        # deepest-first (they run on recursion unwind), then dead-run path
+        # removal (NFA.java:102-103,117-123).
+        def run_body(r, slab):
+            prev_off = state.event_off[r]
+            for h in range(H):
+                en = rec.put_en[r, h]
+                first = en & (rec.put_prev[r, h] < 0)
+                chained = en & (rec.put_prev[r, h] >= 0)
+                slab = slab_mod.put_first(
+                    slab, rec.put_cur[r, h], off,
+                    rec.put_ver[r, h], rec.put_vlen[r, h], enable=first,
+                )
+                slab = slab_mod.put(
+                    slab, rec.put_cur[r, h], off, rec.put_prev[r, h], prev_off,
+                    rec.put_ver[r, h], rec.put_vlen[r, h], enable=chained,
+                )
+            for h in range(H - 1, -1, -1):
+                slab = slab_mod.branch(
+                    slab, rec.br_prev[r, h], prev_off,
+                    rec.br_ver[r, h], rec.br_vlen[r, h], W,
+                    enable=rec.br_en[r, h],
+                )
+            dead_en = rec.dead[r] & (state.event_off[r] >= 0)
+            slab, _, _, _ = slab_mod.peek(
+                slab, jnp.maximum(state.id_pos[r], 0), state.event_off[r],
+                state.ver[r], state.vlen[r], W, remove=True, enable=dead_en,
+            )
+            return slab
+
+        slab = jax.lax.fori_loop(0, R, run_body, state.slab)
+
+        # --- Match construction for final states, after all runs
+        # (NFA.java:111-115), in queue order.
+        final_en = rec.surv_alive & rec.surv_final & valid
+
+        def fin_body(r, carry):
+            slab, out_stage, out_off, out_count = carry
+            slab, st_row, off_row, cnt = slab_mod.peek(
+                slab, rec.surv_id[r], off, rec.surv_ver[r], rec.surv_vlen[r],
+                W, remove=True, enable=final_en[r],
+            )
+            out_stage = out_stage.at[r].set(jnp.where(final_en[r], st_row, out_stage[r]))
+            out_off = out_off.at[r].set(jnp.where(final_en[r], off_row, out_off[r]))
+            out_count = out_count.at[r].set(jnp.where(final_en[r], cnt, 0))
+            return slab, out_stage, out_off, out_count
+
+        slab, out_stage, out_off, out_count = jax.lax.fori_loop(
+            0, R, fin_body,
+            (
+                slab,
+                jnp.full((R, W), -1, i32),
+                jnp.full((R, W), -1, i32),
+                jnp.zeros((R,), i32),
+            ),
+        )
+
+        # --- Next queue: per run [survivor, branches deepest-first, re-seed],
+        # flattened in queue order, compacted into R slots (overflow counted).
+        seed_mask = state.alive & (state.id_pos < 0)
+        reseed_ver = jnp.where(
+            rec.has_succ[:, None],
+            jax.vmap(dewey_ops.add_run)(state.ver, state.vlen),
+            state.ver,
+        )
+
+        def cand(field_surv, field_br, field_seed):
+            # [R] / [R, H] / [R] -> [R, S_CAND]; branches deepest-first.
+            parts = [field_surv[:, None]]
+            if H:
+                parts.append(field_br[:, ::-1])
+            parts.append(field_seed[:, None])
+            return jnp.concatenate(parts, axis=1)
+
+        c_alive = cand(
+            rec.surv_alive & ~rec.surv_final,
+            rec.br_en,
+            seed_mask,
+        )
+        c_id = cand(rec.surv_id, rec.br_id, jnp.full((R,), -1, i32))
+        c_eval = cand(rec.surv_eval, rec.br_eval, jnp.full((R,), begin_pos, i32))
+        c_ver = jnp.concatenate(
+            [rec.surv_ver[:, None, :]]
+            + ([rec.br_run_ver[:, ::-1, :]] if H else [])
+            + [reseed_ver[:, None, :]],
+            axis=1,
+        )
+        c_vlen = cand(rec.surv_vlen, rec.br_run_vlen, state.vlen)
+        c_event = cand(rec.surv_event, rec.br_event, jnp.full((R,), -1, i32))
+        c_start = cand(rec.surv_start, rec.br_start, jnp.full((R,), -1, i32))
+        c_branching = cand(
+            rec.surv_branching,
+            jnp.ones((R, H), bool) if H else jnp.zeros((R, 0), bool),
+            jnp.zeros((R,), bool),
+        )
+        c_agg = jnp.concatenate(
+            [rec.final_agg[:, None, :]]
+            + ([rec.br_agg[:, ::-1, :]] if H else [])
+            + [jnp.broadcast_to(inits, (R, NS))[:, None, :]],
+            axis=1,
+        )
+
+        RS = R * S_CAND
+        flat_alive = c_alive.reshape(RS)
+        idx = jnp.cumsum(flat_alive.astype(i32)) - 1
+        keep = flat_alive & (idx < R)
+        dest = jnp.where(keep, idx, R)
+        dropped = jnp.sum((flat_alive & (idx >= R)).astype(i32))
+
+        def compact(field, fill=0):
+            flat = field.reshape((RS,) + field.shape[2:])
+            out = jnp.full((R + 1,) + flat.shape[1:], fill, flat.dtype)
+            return out.at[dest].set(flat)[:R]
+
+        new_alive = jnp.zeros((R + 1,), bool).at[dest].set(flat_alive)[:R]
+        new_state = EngineState(
+            alive=new_alive,
+            id_pos=compact(c_id, -1),
+            eval_pos=compact(c_eval),
+            ver=compact(c_ver),
+            vlen=compact(c_vlen),
+            event_off=compact(c_event, -1),
+            start_ts=compact(c_start, -1),
+            branching=compact(c_branching, False),
+            agg=compact(c_agg),
+            slab=slab,
+            run_drops=state.run_drops + dropped,
+            ver_overflows=state.ver_overflows + jnp.sum(rec.ovf),
+        )
+
+        # Padding steps leave the state untouched and emit nothing.
+        new_state = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(
+                jnp.reshape(valid, (1,) * n.ndim), n, o
+            ) if n.ndim else jnp.where(valid, n, o),
+            new_state, state,
+        )
+        out = StepOutput(
+            stage=jnp.where(valid, out_stage, -1),
+            off=jnp.where(valid, out_off, -1),
+            count=jnp.where(valid, out_count, 0),
+        )
+        return new_state, out
+
+    def init_state() -> EngineState:
+        i32 = jnp.int32
+        ver = jnp.zeros((R, D), i32).at[0, 0].set(1)
+        return EngineState(
+            alive=jnp.zeros((R,), bool).at[0].set(True),
+            id_pos=jnp.full((R,), -1, i32),
+            eval_pos=jnp.full((R,), begin_pos, i32),
+            ver=ver,
+            vlen=jnp.zeros((R,), i32).at[0].set(1),
+            event_off=jnp.full((R,), -1, i32),
+            start_ts=jnp.full((R,), -1, i32),
+            branching=jnp.zeros((R,), bool),
+            agg=jnp.broadcast_to(inits, (R, NS)).astype(jnp.float32),
+            slab=slab_mod.make(cfg.slab_entries, cfg.slab_preds, D),
+            run_drops=jnp.zeros((), i32),
+            ver_overflows=jnp.zeros((), i32),
+        )
+
+    return step, init_state
+
+
+class TPUMatcher:
+    """A compiled array matcher for one pattern.
+
+    The core object is a pure jitted ``step(state, event) -> (state, output)``
+    over a single key lane; ``scan`` runs a [T]-batch of events under
+    ``lax.scan``, and both vmap cleanly over a leading key axis (see
+    ``parallel/``).  Differential conformance against :class:`OracleNFA` is
+    enforced by ``tests/test_engine*.py``.
+    """
+
+    def __init__(
+        self,
+        pattern,
+        config: Optional[EngineConfig] = None,
+    ):
+        self.tables: TransitionTables = (
+            pattern if isinstance(pattern, TransitionTables) else lower(pattern)
+        )
+        self.config = config or EngineConfig()
+        step, init_state = _build_step(self.tables, self.config)
+        self._step_fn = step
+        self._init_fn = init_state
+        self.step = jax.jit(step)
+        self.scan = jax.jit(self._scan)
+
+    @property
+    def names(self) -> List[str]:
+        return self.tables.names
+
+    def init_state(self) -> EngineState:
+        return self._init_fn()
+
+    def _scan(self, state: EngineState, events: EventBatch):
+        """Run a [T]-stacked batch of events; returns [T]-stacked outputs."""
+        return jax.lax.scan(self._step_fn, state, events)
+
+    def counters(self, state: EngineState) -> Dict[str, int]:
+        """Host-side diagnostic snapshot of all overflow/drop counters."""
+        return {
+            "run_drops": int(state.run_drops),
+            "ver_overflows": int(state.ver_overflows),
+            "slab_full_drops": int(state.slab.full_drops),
+            "slab_pred_drops": int(state.slab.pred_drops),
+            "slab_missing": int(state.slab.missing),
+            "slab_trunc": int(state.slab.trunc),
+        }
+
+
+class MatcherSession:
+    """Stateful single-partition wrapper with the oracle's ``match()`` API.
+
+    Feeds events one at a time through the jitted step, keeps the raw
+    :class:`Event` objects host-side keyed by offset, and decodes completed
+    matches back into :class:`Sequence` objects — the engine analog of
+    ``OracleNFA.match`` for conformance tests and small-scale use.  Event
+    values must be numeric pytrees (scalars or dicts of scalars).
+    """
+
+    def __init__(self, matcher: TPUMatcher):
+        self.matcher = matcher
+        self.state = matcher.init_state()
+        self._events: Dict[int, Event] = {}
+        self._offset = 0
+
+    def match(
+        self,
+        key,
+        value,
+        timestamp: int,
+        topic: str = "test",
+        partition: int = 0,
+        offset: Optional[int] = None,
+    ) -> List[Sequence]:
+        if offset is None:
+            offset = self._offset
+        self._offset = max(self._offset, offset + 1)
+        event = Event(key, value, timestamp, topic, partition, offset)
+        self._events[offset] = event
+        ev = EventBatch(
+            key=jnp.asarray(0 if key is None else key),
+            value=value,
+            ts=jnp.asarray(timestamp, jnp.int32),
+            off=jnp.asarray(offset, jnp.int32),
+            valid=jnp.asarray(True),
+        )
+        self.state, out = self.matcher.step(self.state, ev)
+        return self.decode(out)
+
+    def decode(self, out: StepOutput) -> List[Sequence]:
+        """Materialize one step's matches as :class:`Sequence` objects."""
+        stage, off, count = (np.asarray(jax.device_get(x)) for x in out)
+        names = self.matcher.names
+        matches: List[Sequence] = []
+        for r in range(count.shape[0]):
+            n = int(count[r])
+            if n == 0:
+                continue
+            seq = Sequence()
+            for w in range(n):
+                seq.add(names[int(stage[r, w])], self._events[int(off[r, w])])
+            matches.append(seq)
+        return matches
+
+    def counters(self) -> Dict[str, int]:
+        return self.matcher.counters(self.state)

@@ -11,8 +11,3 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-
-def value_is(expected):
-    """Shared predicate factory used across the conformance suites."""
-    return lambda k, v, ts, store: v == expected

@@ -1,0 +1,149 @@
+"""Shared numeric scenario definitions for the engine differential suites.
+
+Device predicates must be traceable, so the reference scenarios
+(``NFATest.java``) are re-expressed over numeric values: letters become int
+codes (A=0, B=1, C=2, D=3, noise=4), the stock events become dicts of
+scalars.  The SAME pattern objects run on both :class:`OracleNFA` (host
+values) and :class:`TPUMatcher` (traced values) — the predicate algebra's
+dual host/traced semantics (``pattern/predicate.py``) is what makes this
+possible.
+"""
+
+from typing import List
+
+from kafkastreams_cep_tpu import OracleNFA, Query
+from kafkastreams_cep_tpu.engine import EngineConfig, MatcherSession, TPUMatcher
+
+A, B, C, D, X = 0, 1, 2, 3, 4
+
+
+def value_is(code):
+    return lambda k, v, ts, st: v == code
+
+
+def strict3():
+    """NFATest.java:42-67 — strict contiguity SEQ(first, second, latest)."""
+    return (
+        Query()
+        .select("first").where(value_is(A))
+        .then()
+        .select("second").where(value_is(B))
+        .then()
+        .select("latest").where(value_is(C))
+        .build()
+    )
+
+
+def kleene_one_or_more():
+    """NFATest.java:69-101 — SEQ(a, b, c+, d)."""
+    return (
+        Query()
+        .select("firstStage").where(value_is(A))
+        .then()
+        .select("secondStage").where(value_is(B))
+        .then()
+        .select("thirdStage").one_or_more().where(value_is(C))
+        .then()
+        .select("latestState").where(value_is(D))
+        .build()
+    )
+
+
+def skip_till_next():
+    """NFATest.java:104-132."""
+    return (
+        Query()
+        .select("first").where(value_is(A))
+        .then()
+        .select("second").skip_till_next_match().where(value_is(C))
+        .then()
+        .select("latest").skip_till_next_match().where(value_is(D))
+        .build()
+    )
+
+
+def skip_till_any():
+    """NFATest.java:134-172 — nondeterministic branching."""
+    return (
+        Query()
+        .select("first").where(value_is(A))
+        .then()
+        .select("second").where(value_is(B))
+        .then()
+        .select("three").skip_till_any_match().where(value_is(C))
+        .then()
+        .select("latest").skip_till_any_match().where(value_is(D))
+        .build()
+    )
+
+
+def stock_query():
+    """The SASE stock query (NFATest.java:203-245, README.md:22-60) over
+    dict-of-scalar values ``{"price", "volume"}``."""
+    return (
+        Query()
+        .select()
+        .where(lambda k, v, ts, st: v["volume"] > 1000)
+        .fold("avg", lambda k, v, curr: v["price"])
+        .then()
+        .select()
+        .zero_or_more()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, st: v["price"] > st.get("avg"))
+        .fold("avg", lambda k, v, curr: (curr + v["price"]) // 2)
+        .fold("volume", lambda k, v, curr: v["volume"])
+        .then()
+        .select()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, st: v["volume"] < 0.8 * st.get_or_else("volume", 0))
+        .within(1, "h")
+        .build()
+    )
+
+
+STOCKS = [
+    {"price": 100, "volume": 1010},
+    {"price": 120, "volume": 990},
+    {"price": 120, "volume": 1005},
+    {"price": 121, "volume": 999},
+    {"price": 120, "volume": 999},
+    {"price": 125, "volume": 750},
+    {"price": 120, "volume": 950},
+    {"price": 120, "volume": 700},
+]
+
+
+def default_config(**overrides) -> EngineConfig:
+    base = dict(
+        max_runs=16, slab_entries=48, slab_preds=6, dewey_depth=10, max_walk=10
+    )
+    base.update(overrides)
+    return EngineConfig(**base)
+
+
+def canon(seq) -> dict:
+    """Canonical, order-insensitive form of a Sequence for comparison."""
+    return {
+        stage: sorted(e.offset for e in events)
+        for stage, events in seq.as_map().items()
+    }
+
+
+def run_differential(
+    pattern, values, config: EngineConfig = None, ts0: int = 1000
+) -> List:
+    """Step the oracle and the array engine over one trace, asserting
+    identical match emission (count, order, content) at every event."""
+    oracle = OracleNFA.from_pattern(pattern)
+    session = MatcherSession(TPUMatcher(pattern, config or default_config()))
+    matches = []
+    for i, v in enumerate(values):
+        o = oracle.match(None, v, ts0 + i)
+        e = session.match(None, v, ts0 + i)
+        assert len(o) == len(e), f"event {i}: oracle {o} vs engine {e}"
+        for a, b in zip(o, e):
+            assert a == b, f"event {i}: oracle {a} vs engine {b}"
+        matches.extend(e)
+    counters = session.counters()
+    assert all(c == 0 for c in counters.values()), counters
+    return matches

@@ -1,0 +1,7 @@
+"""Shared test helpers (kept out of conftest.py so they import cleanly
+under any pytest import mode)."""
+
+
+def value_is(expected):
+    """Predicate factory used across the conformance suites."""
+    return lambda k, v, ts, store: v == expected

@@ -2,7 +2,7 @@
 ``pattern/StatesFactory.java:41-127`` semantics."""
 
 from kafkastreams_cep_tpu import Query, compile_pattern
-from conftest import value_is
+from helpers import value_is
 from kafkastreams_cep_tpu.compiler.stages import EdgeOperation, Stage, StageType
 
 

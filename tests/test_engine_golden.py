@@ -1,0 +1,71 @@
+"""Array-engine conformance goldens: the five reference scenarios
+(``NFATest.java``) run differentially against the host oracle — every event's
+match emission must be identical in count, order, and content."""
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu.engine import EngineConfig, MatcherSession, TPUMatcher
+
+A, B, C, D, X = sc.A, sc.B, sc.C, sc.D, sc.X
+
+
+def test_strict_contiguity_differential():
+    matches = sc.run_differential(sc.strict3(), [A, B, C])
+    assert len(matches) == 1
+    assert sc.canon(matches[0]) == {"first": [0], "second": [1], "latest": [2]}
+
+
+def test_strict_contiguity_rejects_gaps():
+    assert sc.run_differential(sc.strict3(), [A, X, B, C, A, B, C]) != []
+
+
+def test_kleene_one_or_more_differential():
+    matches = sc.run_differential(sc.kleene_one_or_more(), [A, B, C, C, D])
+    assert len(matches) == 1
+    assert sc.canon(matches[0]) == {
+        "firstStage": [0],
+        "secondStage": [1],
+        "thirdStage": [2, 3],
+        "latestState": [4],
+    }
+
+
+def test_skip_till_next_match_differential():
+    matches = sc.run_differential(sc.skip_till_next(), [A, B, C, C, D])
+    assert len(matches) == 1
+    assert sc.canon(matches[0]) == {"first": [0], "second": [2], "latest": [4]}
+
+
+def test_skip_till_any_match_branches_differential():
+    matches = sc.run_differential(sc.skip_till_any(), [A, B, C, C, D])
+    assert len(matches) == 2
+    assert sc.canon(matches[0]) == {
+        "first": [0], "second": [1], "three": [2], "latest": [4]
+    }
+    assert sc.canon(matches[1]) == {
+        "first": [0], "second": [1], "three": [3], "latest": [4]
+    }
+
+
+def test_stock_query_differential():
+    matches = sc.run_differential(
+        sc.stock_query(),
+        sc.STOCKS,
+        sc.default_config(max_runs=24, slab_entries=64, slab_preds=8,
+                          dewey_depth=12, max_walk=12),
+    )
+    assert len(matches) == 4
+
+
+def test_overflow_counters_surface():
+    # An undersized run queue must *count* dropped runs, never silently
+    # truncate (no reference analog — the Java queue is unbounded).
+    session = MatcherSession(
+        TPUMatcher(
+            sc.skip_till_any(),
+            EngineConfig(max_runs=2, slab_entries=16, slab_preds=4,
+                         dewey_depth=6, max_walk=6),
+        )
+    )
+    for i, v in enumerate([A, B, C, C, C, D]):
+        session.match(None, v, 1000 + i)
+    assert session.counters()["run_drops"] > 0

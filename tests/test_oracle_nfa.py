@@ -7,7 +7,7 @@ import time
 from typing import List
 
 from kafkastreams_cep_tpu import Event, OracleNFA, Query, Sequence
-from conftest import value_is
+from helpers import value_is
 
 NOW = int(time.time() * 1000)
 

@@ -13,7 +13,7 @@ import jax
 import numpy as np
 
 from kafkastreams_cep_tpu import DeweyVersion, Event, OracleNFA, Query
-from conftest import value_is
+from helpers import value_is
 from kafkastreams_cep_tpu.compiler.stages import compile_pattern
 from kafkastreams_cep_tpu.nfa.buffer import SharedVersionedBuffer
 from kafkastreams_cep_tpu.ops import dewey_ops, slab

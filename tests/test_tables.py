@@ -8,7 +8,7 @@ engine consumes these tables, so their shape is load-bearing.
 import numpy as np
 
 from kafkastreams_cep_tpu import Query
-from conftest import value_is
+from helpers import value_is
 from kafkastreams_cep_tpu.compiler.tables import (
     OP_BEGIN,
     OP_NONE,
