@@ -163,6 +163,31 @@ def _as_bool(x) -> jnp.ndarray:
     return jnp.asarray(x, dtype=bool).reshape(())
 
 
+# Single source of truth for the engine's overflow/drop diagnostics; every
+# aggregator (matcher, batch, sharded) derives its reporting from this pair
+# so names and order can never drift.
+COUNTER_NAMES = (
+    "run_drops",
+    "ver_overflows",
+    "slab_full_drops",
+    "slab_pred_drops",
+    "slab_missing",
+    "slab_trunc",
+)
+
+
+def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
+    """The counters of ``state`` in ``COUNTER_NAMES`` order."""
+    return (
+        state.run_drops,
+        state.ver_overflows,
+        state.slab.full_drops,
+        state.slab.pred_drops,
+        state.slab.missing,
+        state.slab.trunc,
+    )
+
+
 class _ChainRecord(NamedTuple):
     """Everything one run's chain produced, consumed by the slab pass."""
 
@@ -212,6 +237,13 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
     ignore_pred = jnp.asarray(tables.ignore_pred)
     proceed_pred = jnp.asarray(tables.proceed_pred)
     proceed_target = jnp.asarray(tables.proceed_target)
+    # Device time is int32 (TPU-native width; callers rebase epoch-ms via
+    # the runtime's `epoch`, runtime/processor.py).  Windows must fit too.
+    if tables.window_ms.max(initial=-1) > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"window of {int(tables.window_ms.max())} ms exceeds int32 device "
+            "time; windows up to ~24.8 days are supported"
+        )
     window_ms = jnp.asarray(tables.window_ms.astype(np.int32))
     final_pos = int(tables.final_pos)
     begin_pos = int(tables.begin_pos)
@@ -605,12 +637,7 @@ class TPUMatcher:
     def counters(self, state: EngineState) -> Dict[str, int]:
         """Host-side diagnostic snapshot of all overflow/drop counters."""
         return {
-            "run_drops": int(state.run_drops),
-            "ver_overflows": int(state.ver_overflows),
-            "slab_full_drops": int(state.slab.full_drops),
-            "slab_pred_drops": int(state.slab.pred_drops),
-            "slab_missing": int(state.slab.missing),
-            "slab_trunc": int(state.slab.trunc),
+            n: int(v) for n, v in zip(COUNTER_NAMES, counter_values(state))
         }
 
 

@@ -1,0 +1,19 @@
+"""Data-parallel and multi-chip execution of the array NFA engine.
+
+The reference scales out with Kafka partition parallelism — one NFA per
+(topic, partition), state externalized per partition, partitions spread
+across tasks and instances (``CEPProcessor.java:117-134,160``).  The TPU
+analog (SURVEY §2.2) is the **key axis**: every key lane owns an independent
+fixed-shape engine state, so
+
+* on one chip, lanes batch via ``vmap`` (:class:`BatchMatcher`), and
+* across chips, the lane axis shards over a ``jax.sharding.Mesh`` via
+  ``jax.shard_map`` (:class:`ShardedMatcher`) — matching itself needs no
+  collectives (lanes never communicate, like Kafka partitions), while
+  global diagnostics ride ``psum`` over ICI/DCN.
+"""
+
+from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher, key_mesh
+
+__all__ = ["BatchMatcher", "ShardedMatcher", "key_mesh"]

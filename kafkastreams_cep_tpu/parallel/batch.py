@@ -1,0 +1,89 @@
+"""Single-chip key-batched matcher: ``vmap`` of the engine over lanes.
+
+The reference runs one independent NFA per Kafka partition
+(``CEPProcessor.java:117-134``); here each *lane* of a ``[K]`` leading axis
+is one such independent matcher (state + slab), stepped in lockstep by one
+compiled dispatch.  This is the unit the mesh layer shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kafkastreams_cep_tpu.engine.matcher import (
+    COUNTER_NAMES,
+    EngineConfig,
+    EngineState,
+    EventBatch,
+    StepOutput,
+    TPUMatcher,
+    counter_values,
+)
+
+
+def broadcast_state(state: EngineState, num_lanes: int) -> EngineState:
+    """Tile one lane's engine state to a ``[K]`` leading axis."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (num_lanes,) + x.shape), state
+    )
+
+
+def lane_step(step_one):
+    """Lift a per-lane step to a ``[K]``-batched step (shared by the batch
+    and sharded matchers so lane semantics can never diverge)."""
+
+    def step(state: EngineState, ev: EventBatch):
+        return jax.vmap(step_one)(state, ev)
+
+    return step
+
+
+def lane_scan(step_one):
+    """Lift a per-lane step to a ``[K, T]`` scanned batch."""
+
+    def scan(state: EngineState, events: EventBatch):
+        return jax.vmap(lambda s, e: jax.lax.scan(step_one, s, e))(
+            state, events
+        )
+
+    return scan
+
+
+class BatchMatcher:
+    """``K`` independent per-key matchers stepped as one array program.
+
+    ``step`` consumes one event per lane (``EventBatch`` leaves shaped
+    ``[K, ...]``); ``scan`` consumes a ``[K, T]`` time-stacked batch and runs
+    the whole window in a single ``lax.scan`` dispatch — the shape the
+    micro-batcher (``runtime/processor.py``) and the benchmarks feed.
+    """
+
+    def __init__(
+        self,
+        pattern,
+        num_lanes: int,
+        config: Optional[EngineConfig] = None,
+    ):
+        self.matcher = TPUMatcher(pattern, config)
+        self.num_lanes = int(num_lanes)
+        self._step_fn = lane_step(self.matcher._step_fn)
+        self._scan_fn = lane_scan(self.matcher._step_fn)
+        self.step = jax.jit(self._step_fn)
+        self.scan = jax.jit(self._scan_fn)
+
+    @property
+    def names(self):
+        return self.matcher.names
+
+    def init_state(self) -> EngineState:
+        return broadcast_state(self.matcher.init_state(), self.num_lanes)
+
+    def counters(self, state: EngineState) -> Dict[str, int]:
+        """Aggregate overflow/drop counters summed over all lanes."""
+        return {
+            n: int(jnp.sum(v))
+            for n, v in zip(COUNTER_NAMES, counter_values(state))
+        }

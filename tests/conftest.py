@@ -2,12 +2,25 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately dry-run-compiles the
-multi-chip path via ``__graft_entry__.dryrun_multichip``).
+multi-chip path via ``__graft_entry__.dryrun_multichip``, and ``bench.py``
+runs on the real chip).  Set ``CEP_TEST_TPU=1`` to run the suite on
+whatever platform the environment provides instead (the sharding tests
+then skip if fewer than 8 devices are present).
+
+The environment's site hook pins ``JAX_PLATFORMS`` to the TPU plugin before
+any code runs, so the env var alone is not enough — the platform is forced
+through ``jax.config`` after import, before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("CEP_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
