@@ -1,0 +1,111 @@
+"""The SASE stock demo, end-to-end through the TPU runtime.
+
+Reproduces ``demo/CEPStockKStreamsDemo.java:25-103`` — the paper's stock
+query over the 8-event trace documented at ``/root/reference/README.md:
+69-97`` — and prints the same 4 JSON match lines, byte for byte.
+
+Run: ``python examples/stock_demo.py`` (add ``CEP_PLATFORM=cpu`` to skip
+the TPU compile wait; the environment's site hook pins ``JAX_PLATFORMS``,
+so that variable alone cannot select the platform here).
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+if os.environ.get("CEP_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["CEP_PLATFORM"])
+
+from kafkastreams_cep_tpu import Query
+from kafkastreams_cep_tpu.engine import EngineConfig
+from kafkastreams_cep_tpu.runtime import CEPProcessor, Record
+
+STOCK_EVENTS = [
+    {"name": "e1", "price": 100, "volume": 1010},
+    {"name": "e2", "price": 120, "volume": 990},
+    {"name": "e3", "price": 120, "volume": 1005},
+    {"name": "e4", "price": 121, "volume": 999},
+    {"name": "e5", "price": 120, "volume": 999},
+    {"name": "e6", "price": 125, "volume": 750},
+    {"name": "e7", "price": 120, "volume": 950},
+    {"name": "e8", "price": 120, "volume": 700},
+]
+
+
+def stock_pattern():
+    """The demo query (``CEPStockKStreamsDemo.java:37-53``)."""
+    return (
+        Query()
+        .select()
+        .where(lambda k, v, ts, st: v["volume"] > 1000)
+        .fold("avg", lambda k, v, curr: v["price"])
+        .then()
+        .select()
+        .zero_or_more()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, st: v["price"] > st.get("avg"))
+        .fold("avg", lambda k, v, curr: (curr + v["price"]) // 2)
+        .fold("volume", lambda k, v, curr: v["volume"])
+        .then()
+        .select()
+        .skip_till_next_match()
+        .where(lambda k, v, ts, st: v["volume"] < 0.8 * st.get_or_else("volume", 0))
+        .within(1, "h")
+        .build()
+    )
+
+
+def format_match(seq, name_of) -> str:
+    """One match -> the demo's JSON line: stages first->last, events in
+    arrival order (the demo reverses the backward-walk order,
+    ``CEPStockKStreamsDemo.java:60-69``)."""
+    obj = {}
+    for stage, events in reversed(list(seq.as_map().items())):
+        obj[stage] = [name_of[e.offset] for e in reversed(events)]
+    return json.dumps(obj, separators=(",", ":"))
+
+
+def run(processor=None):
+    """Feed the trace; return the JSON lines (shared with the test)."""
+    proc = processor or CEPProcessor(
+        stock_pattern(),
+        num_lanes=1,
+        config=EngineConfig(
+            max_runs=32, slab_entries=64, slab_preds=8, dewey_depth=16,
+            max_walk=16,
+        ),
+        topic="StockEvents",
+    )
+    name_of = {i: ev["name"] for i, ev in enumerate(STOCK_EVENTS)}
+    records = [
+        Record("stocks", {"price": ev["price"], "volume": ev["volume"]}, 1000 + i)
+        for i, ev in enumerate(STOCK_EVENTS)
+    ]
+    lines = []
+    for key, seq in proc.process(records):
+        lines.append(format_match(seq, name_of))
+    counters = proc.counters()
+    assert all(v == 0 for v in counters.values()), counters
+    return lines
+
+
+EXPECTED = [
+    '{"0":["e1"],"1":["e2","e3","e4","e5"],"2":["e6"]}',
+    '{"0":["e3"],"1":["e4"],"2":["e6"]}',
+    '{"0":["e1"],"1":["e2","e3","e4","e5","e6","e7"],"2":["e8"]}',
+    '{"0":["e3"],"1":["e4","e6"],"2":["e8"]}',
+]
+
+
+if __name__ == "__main__":
+    lines = run()
+    for line in lines:
+        print(line)
+    ok = lines == EXPECTED
+    print("README parity:", "OK" if ok else "MISMATCH", file=sys.stderr)
+    sys.exit(0 if ok else 1)

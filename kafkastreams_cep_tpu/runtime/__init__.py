@@ -1,0 +1,29 @@
+"""Host runtime: the stream-processor layer above the device engine.
+
+The reference integrates with Kafka Streams through ``CEPProcessor``
+(``CEPProcessor.java:50-163``): per-record processing, per-partition state
+ownership, store-backed checkpointing, match forwarding.  Here the same
+responsibilities are host-side Python around the batched device matcher:
+
+* :class:`CEPProcessor` — micro-batches records by key lane, pads to the
+  device shape, scans, and emits completed :class:`Sequence` matches in
+  arrival order (``runtime/processor.py``);
+* :mod:`runtime.checkpoint` — snapshot/restore of the device state arrays
+  with stages referenced by name only, so code never serializes
+  (``ComputationStageSerDe.java:40-123`` contract).
+"""
+
+from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
+from kafkastreams_cep_tpu.runtime.checkpoint import (
+    restore_processor,
+    save_checkpoint,
+    load_checkpoint,
+)
+
+__all__ = [
+    "CEPProcessor",
+    "Record",
+    "save_checkpoint",
+    "load_checkpoint",
+    "restore_processor",
+]

@@ -1,0 +1,141 @@
+"""Checkpoint / restore of processor state — the changelog-store analog.
+
+The reference persists its entire engine state in Kafka Streams changelog
+stores: the run queue re-serialized every record (``CEPProcessor.java:
+158-160``) and the buffer/aggregate stores mutated through the store API.
+Critically, **code is never serialized** — runs reference stages by *name*
+and are rehydrated from the compiled topology on restore
+(``ComputationStageSerDe.java:40-46,66-78``).
+
+The TPU analog: all canonical state already lives in fixed-shape device
+arrays (:class:`EngineState`), so a checkpoint is a host-side snapshot of
+those arrays plus the host bookkeeping (key→lane map, per-lane event store,
+offsets).  The manifest records the compiled stage *names*; restore
+compiles the pattern fresh from user code and refuses a topology whose
+names differ — exactly the reference's lookup-by-name contract.
+
+Format: one ``.npz`` for the arrays + a pickled header for host metadata
+(events and keys are user objects — pickle is the Kryo analog; predicates
+and fold functions are never written).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EngineState
+from kafkastreams_cep_tpu.runtime.processor import CEPProcessor
+
+FORMAT_VERSION = 1
+
+
+def _flatten_state(state: EngineState) -> Dict[str, np.ndarray]:
+    """EngineState -> flat ``{path: ndarray}`` with stable names."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    out = {}
+    for path, leaf in flat:
+        name = "/".join(
+            p.name if hasattr(p, "name") else str(p.idx) for p in path
+        )
+        out[name] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def _unflatten_state(template: EngineState, arrays: Dict[str, np.ndarray]) -> EngineState:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        name = "/".join(
+            p.name if hasattr(p, "name") else str(p.idx) for p in path
+        )
+        if name not in arrays:
+            raise ValueError(f"checkpoint missing state array {name!r}")
+        arr = arrays[name]
+        if arr.shape != leaf.shape:
+            raise ValueError(
+                f"checkpoint array {name!r} has shape {arr.shape}, "
+                f"engine expects {leaf.shape} (EngineConfig mismatch?)"
+            )
+        leaves.append(arr.astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_checkpoint(processor: CEPProcessor, path: str) -> None:
+    """Snapshot a processor's full state to ``path`` (a single file)."""
+    arrays = _flatten_state(processor.state)
+    header = {
+        "format_version": FORMAT_VERSION,
+        # Stage names only — the lookup-by-name restore contract.
+        "stage_names": list(processor.batch.names),
+        "state_names": list(processor.batch.matcher.tables.state_names),
+        "config": dataclasses.asdict(processor.batch.matcher.config),
+        "num_lanes": processor.num_lanes,
+        "topic": processor.topic,
+        "epoch": processor.epoch,
+        "gc_events": processor.gc_events,
+        "lane_of": dict(processor._lane_of),
+        "next_offset": processor._next_offset.copy(),
+        "events": [dict(d) for d in processor._events],
+        "value_proto": processor._value_proto,
+    }
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with open(path, "wb") as f:
+        pickle.dump({"header": header, "arrays": buf.getvalue()}, f)
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    """Read a checkpoint file into ``{header, arrays}``."""
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    header = blob["header"]
+    if header["format_version"] != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {header['format_version']} unsupported"
+        )
+    with np.load(io.BytesIO(blob["arrays"])) as z:
+        arrays = {k: z[k] for k in z.files}
+    return {"header": header, "arrays": arrays}
+
+
+def restore_processor(pattern, path: str) -> CEPProcessor:
+    """Rebuild a processor from user code + a checkpoint.
+
+    ``pattern`` is compiled fresh (predicates/folds come from code, exactly
+    like ``ComputationStageSerDe`` rehydrating stages from the topology);
+    the checkpoint supplies only state.  A topology whose stage names don't
+    match the checkpoint is refused.
+    """
+    ckpt = load_checkpoint(path)
+    header = ckpt["header"]
+    config = EngineConfig(**header["config"])
+    proc = CEPProcessor(
+        pattern,
+        header["num_lanes"],
+        config,
+        topic=header["topic"],
+        epoch=header["epoch"],
+        gc_events=header["gc_events"],
+    )
+    if list(proc.batch.names) != list(header["stage_names"]):
+        raise ValueError(
+            "pattern topology does not match checkpoint: stages "
+            f"{proc.batch.names} vs checkpoint {header['stage_names']}"
+        )
+    if list(proc.batch.matcher.tables.state_names) != list(header["state_names"]):
+        raise ValueError("fold-state names do not match checkpoint")
+    proc.state = jax.device_put(
+        _unflatten_state(proc.state, ckpt["arrays"])
+    )
+    proc._lane_of = dict(header["lane_of"])
+    proc._key_of = {v: k for k, v in proc._lane_of.items()}
+    proc._next_offset = np.asarray(header["next_offset"]).copy()
+    proc._events = [dict(d) for d in header["events"]]
+    proc._value_proto = header["value_proto"]
+    return proc

@@ -1,0 +1,275 @@
+"""The stream-processor analog: micro-batched host→device record pump.
+
+Reference: ``CEPProcessor.java:88-163``.  The reference receives one record
+at a time from Kafka Streams, steps one NFA, and forwards matches.  Here a
+*micro-batch* of records is grouped by key into device lanes (the partition
+analog, SURVEY §2.2), padded to a rectangular ``[K, T]`` batch, scanned in
+one device dispatch, and the completed matches are decoded and emitted in
+exact arrival order — the order the reference would have forwarded them.
+
+Lane ownership mirrors the reference's per-partition state contract
+(``CEPProcessor.java:117-134``): each key owns one lane's run queue, slab,
+and fold state for the processor's lifetime; checkpoints externalize those
+arrays (``runtime/checkpoint.py``).
+
+Time is int32 on device (the TPU-native width).  Epoch-millisecond
+timestamps don't fit, so the processor subtracts a fixed ``epoch`` (default:
+the first record's timestamp) from every record before transfer; windows
+compare time *differences*, which rebasing preserves exactly.  Predicates
+therefore observe rebased timestamps — pass ``epoch=0`` if a predicate
+matches on absolute time and your timestamps are small.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Hashable, List, NamedTuple, Optional, Sequence as Seq, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+from kafkastreams_cep_tpu.utils.events import Event, Sequence
+
+logger = logging.getLogger("kafkastreams_cep_tpu.runtime")
+
+_I32 = np.iinfo(np.int32)
+
+
+class Record(NamedTuple):
+    """One input record, the host analog of a Kafka ``(key, value, ts)``."""
+
+    key: Hashable
+    value: Any
+    timestamp: int
+
+
+def _bucket(t: int) -> int:
+    """Round a batch length up to the next power of two so recompiles are
+    bounded (one trace per bucket) instead of one per distinct length."""
+    n = 1
+    while n < t:
+        n *= 2
+    return n
+
+
+class CEPProcessor:
+    """Micro-batching processor: records in, :class:`Sequence` matches out.
+
+    ``num_lanes`` bounds the number of distinct keys (the partition count
+    analog); a new key claims a free lane and keeps it for the processor's
+    lifetime — one more key than lanes raises, like an unassigned Kafka
+    partition would.  Values must share one numeric pytree structure
+    (scalars or nested dicts of scalars): they are stacked into device
+    arrays and handed to predicates as traced pytrees.  The first record
+    fixes the schema (leaf structure and int/float dtypes), like a serde; a
+    later record with a float where the schema says int is rejected rather
+    than silently truncated.
+
+    Predicates receive the record key as a numeric scalar: integer keys
+    pass through unchanged; any other key type is represented by its lane
+    index (keys must then not be matched on — the reference's lambdas can
+    close over arbitrary keys, a device program cannot).
+
+    ``process(records)`` accepts any number of records, splits them into
+    per-lane queues, pads to the max queue length (bucketed to powers of
+    two so jit retraces are bounded), scans the whole batch in one jitted
+    dispatch, and returns ``(key, Sequence)`` pairs in the exact order the
+    reference's per-record loop would have forwarded them
+    (``CEPProcessor.java:154-163``): by arrival of the completing record,
+    then run-queue order.
+    """
+
+    def __init__(
+        self,
+        pattern,
+        num_lanes: int,
+        config: Optional[EngineConfig] = None,
+        topic: str = "stream",
+        epoch: Optional[int] = None,
+        gc_events: bool = True,
+    ):
+        self.batch = BatchMatcher(pattern, num_lanes, config)
+        self.topic = topic
+        self.num_lanes = int(num_lanes)
+        self.state = self.batch.init_state()
+        self.epoch = epoch  # None = rebase to the first record's timestamp
+        self.gc_events = gc_events
+        self._lane_of: Dict[Hashable, int] = {}
+        self._key_of: Dict[int, Hashable] = {}
+        self._next_offset = np.zeros(self.num_lanes, dtype=np.int64)
+        self._events: List[Dict[int, Event]] = [dict() for _ in range(self.num_lanes)]
+        self._value_proto = None
+
+    # -- key -> lane assignment (partition-assignment analog) ---------------
+
+    def lane(self, key: Hashable) -> int:
+        existing = self._lane_of.get(key)
+        if existing is not None:
+            return existing
+        lane = len(self._lane_of)
+        if lane >= self.num_lanes:
+            raise ValueError(
+                f"more than num_lanes={self.num_lanes} distinct keys; "
+                f"size the processor for the key cardinality it serves"
+            )
+        self._lane_of[key] = lane
+        self._key_of[lane] = key
+        logger.info("assigned key %r to lane %d", key, lane)
+        return lane
+
+    def _key_code(self, key: Hashable, lane: int) -> int:
+        if isinstance(key, (int, np.integer)) and _I32.min <= key <= _I32.max:
+            return int(key)
+        return lane
+
+    def _rebased_ts(self, timestamp: int) -> int:
+        rel = int(timestamp) - self.epoch
+        if not (_I32.min <= rel <= _I32.max):
+            raise ValueError(
+                f"timestamp {timestamp} is {rel} ms from the processor epoch "
+                f"{self.epoch}, outside int32 device time (~±24.8 days); "
+                "construct the processor with an epoch near your stream's "
+                "timestamps"
+            )
+        return rel
+
+    # -- the per-batch hot path --------------------------------------------
+
+    def process(self, records: Seq[Record]) -> List[Tuple[Hashable, Sequence]]:
+        if not records:
+            return []
+        K = self.num_lanes
+        if self.epoch is None:
+            self.epoch = int(records[0].timestamp)
+        if self._value_proto is None:
+            # A pytree of dtypes with the records' value structure (kept as
+            # plain picklable objects for the checkpoint header).
+            leaves0, treedef0 = jax.tree_util.tree_flatten(records[0].value)
+            self._value_proto = jax.tree_util.tree_unflatten(
+                treedef0,
+                [
+                    np.dtype(np.float32)
+                    if np.issubdtype(np.asarray(l).dtype, np.floating)
+                    else np.dtype(np.int32)
+                    for l in leaves0
+                ],
+            )
+        dtypes, treedef = jax.tree_util.tree_flatten(self._value_proto)
+
+        # Validate the whole batch BEFORE mutating any lane bookkeeping, so
+        # a bad record rejects the batch atomically (nothing half-ingested).
+        lanes = [self.lane(rec.key) for rec in records]
+        rel_ts = [self._rebased_ts(rec.timestamp) for rec in records]
+        batch_leaves = []
+        for rank, rec in enumerate(records):
+            leaves = jax.tree_util.tree_leaves(rec.value)
+            if len(leaves) != len(dtypes):
+                raise ValueError(
+                    f"record {rank}: value structure differs from the "
+                    "schema fixed by the first record"
+                )
+            for leaf, dt in zip(leaves, dtypes):
+                if np.issubdtype(np.asarray(leaf).dtype, np.floating) and not np.issubdtype(dt, np.floating):
+                    raise ValueError(
+                        f"record {rank}: float value {leaf!r} in a field the "
+                        "schema (fixed by the first record) typed as int"
+                    )
+            batch_leaves.append(leaves)
+
+        # Group into per-lane queues, remembering each record's arrival rank.
+        queues: List[List[int]] = [[] for _ in range(K)]
+        events_by_rank: List[Event] = []
+        for rank, rec in enumerate(records):
+            lane = lanes[rank]
+            off = int(self._next_offset[lane])
+            self._next_offset[lane] += 1
+            event = Event(
+                rec.key, rec.value, int(rec.timestamp), self.topic, lane, off
+            )
+            self._events[lane][off] = event
+            events_by_rank.append(event)
+            queues[lane].append(rank)
+
+        T = _bucket(max(len(q) for q in queues))
+
+        # Pad to [K, T]; padding slots carry valid=False and leave lane
+        # state untouched (engine contract, matcher.py step()).
+        key_arr = np.zeros((K, T), dtype=np.int32)
+        ts = np.zeros((K, T), dtype=np.int32)
+        off = np.zeros((K, T), dtype=np.int32)
+        valid = np.zeros((K, T), dtype=bool)
+        rank_of = np.full((K, T), -1, dtype=np.int64)
+        val_leaves = [np.zeros((K, T), dtype=dt) for dt in dtypes]
+        for k, q in enumerate(queues):
+            for t, rank in enumerate(q):
+                ev = events_by_rank[rank]
+                key_arr[k, t] = self._key_code(ev.key, k)
+                ts[k, t] = rel_ts[rank]
+                off[k, t] = ev.offset
+                valid[k, t] = True
+                rank_of[k, t] = rank
+                for i, leaf in enumerate(batch_leaves[rank]):
+                    val_leaves[i][k, t] = leaf
+
+        events = EventBatch(
+            key=jnp.asarray(key_arr),
+            value=jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(v) for v in val_leaves]
+            ),
+            ts=jnp.asarray(ts),
+            off=jnp.asarray(off),
+            valid=jnp.asarray(valid),
+        )
+
+        self.state, out = self.batch.scan(self.state, events)
+        matches = self._decode(out, rank_of)
+        if self.gc_events:
+            self._gc_events()
+        return matches
+
+    def _decode(self, out, rank_of) -> List[Tuple[Hashable, Sequence]]:
+        """Device walk outputs -> (key, Sequence), in arrival order."""
+        stage = np.asarray(jax.device_get(out.stage))  # [K, T, R, W]
+        off = np.asarray(jax.device_get(out.off))
+        count = np.asarray(jax.device_get(out.count))  # [K, T, R]
+        names = self.batch.names
+        hits: List[Tuple[int, int, Hashable, Sequence]] = []
+        for k, t, r in zip(*np.nonzero(count)):
+            seq = Sequence()
+            for w in range(int(count[k, t, r])):
+                seq.add(
+                    names[int(stage[k, t, r, w])],
+                    self._events[k][int(off[k, t, r, w])],
+                )
+            hits.append((int(rank_of[k, t]), int(r), self._key_of[int(k)], seq))
+        hits.sort(key=lambda h: (h[0], h[1]))
+        return [(key, seq) for _, _, key, seq in hits]
+
+    def _gc_events(self) -> None:
+        """Drop host events no longer reachable from device state.
+
+        The device slab GCs entries by refcount exactly like the reference
+        buffer (``KVSharedVersionedBuffer.java:147-171``); the host mirror
+        only needs events still present in a lane's slab or pointed at by a
+        live run, so everything else is released here after each batch.
+        """
+        slab_stage = np.asarray(jax.device_get(self.state.slab.stage))  # [K, E]
+        slab_off = np.asarray(jax.device_get(self.state.slab.off))
+        run_alive = np.asarray(jax.device_get(self.state.alive))  # [K, R]
+        run_off = np.asarray(jax.device_get(self.state.event_off))
+        for k in range(self.num_lanes):
+            live = set(slab_off[k][slab_stage[k] >= 0].tolist())
+            live.update(run_off[k][run_alive[k]].tolist())
+            store = self._events[k]
+            dead = [o for o in store if o not in live]
+            for o in dead:
+                del store[o]
+
+    # -- diagnostics --------------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        """Lane-summed overflow/drop counters (all zero in healthy runs)."""
+        return self.batch.counters(self.state)
