@@ -1,0 +1,154 @@
+"""Benchmark harness: events/sec/chip on the SASE stock pattern.
+
+Prints ONE JSON line to stdout:
+``{"metric": ..., "value": N, "unit": "events/s", "vs_baseline": N}``.
+
+* **Headline config** (BASELINE.json configs[0]/[2] hybrid): the stock query
+  over ``K`` vmapped key lanes × ``T`` scanned events per lane on one chip —
+  the production dispatch shape (``parallel/batch.py``).
+* **Parity gate**: before timing, the 8-event demo trace must reproduce the
+  reference README's 4 match sequences exactly (README.md:93-96) through
+  the same engine; a parity failure aborts the bench.
+* **vs_baseline**: the reference publishes no numbers (BASELINE.md), so the
+  ratio is measured against this repo's host oracle (``nfa/oracle.py``) — a
+  faithful single-event-loop reimplementation of the reference engine
+  (``NFA.java:94-289``) whose store-bound Java original is in the same
+  throughput class (BASELINE.md "derived cost notes").
+
+Environment knobs: ``CEP_BENCH_K`` (lanes, default 4096), ``CEP_BENCH_T``
+(events/lane/scan, default 256), ``CEP_BENCH_REPS`` (timed scans, default
+3), ``CEP_BENCH_ORACLE_N`` (oracle-timed events, default 4000),
+``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
+
+All diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+if os.environ.get("CEP_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["CEP_PLATFORM"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples"))
+
+import stock_demo
+from kafkastreams_cep_tpu import OracleNFA
+from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.parallel import BatchMatcher
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def parity_gate():
+    """The engine must reproduce the README's 4 stock matches exactly."""
+    lines = stock_demo.run()
+    if lines != stock_demo.EXPECTED:
+        log(f"PARITY FAILURE: {lines}")
+        raise SystemExit(2)
+    log("parity gate: README 4-sequence output reproduced exactly")
+
+
+def make_batch(rng, K, T):
+    prices = rng.integers(90, 131, size=(K, T)).astype(np.int32)
+    volumes = rng.integers(600, 1101, size=(K, T)).astype(np.int32)
+    return EventBatch(
+        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
+        value={"price": jnp.asarray(prices), "volume": jnp.asarray(volumes)},
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :] * 2, (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+
+
+def bench_engine(K, T, reps):
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12, max_walk=12
+    )
+    batch = BatchMatcher(stock_demo.stock_pattern(), K, cfg)
+    state0 = batch.init_state()
+    rng = np.random.default_rng(42)
+    events = make_batch(rng, K, T)
+
+    t0 = time.perf_counter()
+    state, out = batch.scan(state0, events)
+    jax.block_until_ready(out.count)
+    compile_s = time.perf_counter() - t0
+    log(f"engine: compile+first scan {compile_s:.1f}s on {jax.devices()[0]}")
+
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        state, out = batch.scan(state0, events)
+        jax.block_until_ready(out.count)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        log(f"engine: scan {i + 1}/{reps}: {dt * 1e3:.1f} ms "
+            f"({K * T / dt / 1e6:.2f}M ev/s)")
+    counters = batch.counters(state)
+    log(f"engine: counters {counters} (capacity drops are policy, counted)")
+    matches = int(jnp.sum(out.count > 0))
+    log(f"engine: {matches} run-slots completed matches in final scan")
+    return K * T / best
+
+
+def bench_oracle(n_events):
+    rng = np.random.default_rng(42)
+    prices = rng.integers(90, 131, size=n_events)
+    volumes = rng.integers(600, 1101, size=n_events)
+    oracle = OracleNFA.from_pattern(stock_demo.stock_pattern())
+    t0 = time.perf_counter()
+    n_matches = 0
+    for i in range(n_events):
+        n_matches += len(
+            oracle.match(
+                None,
+                {"price": int(prices[i]), "volume": int(volumes[i])},
+                2 * i,
+                offset=i,
+            )
+        )
+    dt = time.perf_counter() - t0
+    log(f"oracle: {n_events} events in {dt:.2f}s "
+        f"({n_events / dt / 1e3:.1f}K ev/s), {n_matches} matches")
+    return n_events / dt
+
+
+def main():
+    K = int(os.environ.get("CEP_BENCH_K", "4096"))
+    T = int(os.environ.get("CEP_BENCH_T", "256"))
+    reps = int(os.environ.get("CEP_BENCH_REPS", "3"))
+    oracle_n = int(os.environ.get("CEP_BENCH_ORACLE_N", "4000"))
+
+    parity_gate()
+    engine_evps = bench_engine(K, T, reps)
+    oracle_evps = bench_oracle(oracle_n)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "events/sec/chip, SASE stock pattern, "
+                    f"{K} key lanes x {T}-event scan, README match parity"
+                ),
+                "value": round(engine_evps, 1),
+                "unit": "events/s",
+                "vs_baseline": round(engine_evps / oracle_evps, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
