@@ -18,6 +18,7 @@ Prints ONE JSON line to stdout:
 Environment knobs: ``CEP_BENCH_K`` (lanes, default 4096), ``CEP_BENCH_T``
 (events/lane/scan, default 256), ``CEP_BENCH_REPS`` (timed scans, default
 3), ``CEP_BENCH_ORACLE_N`` (oracle-timed events, default 4000),
+``CEP_BENCH_STENCIL_N`` (strict-SEQ stencil events, default 1048576),
 ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
@@ -41,8 +42,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples"))
 
 import stock_demo
-from kafkastreams_cep_tpu import OracleNFA
-from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
+from kafkastreams_cep_tpu import OracleNFA, Query
+from kafkastreams_cep_tpu.engine import (
+    EngineConfig,
+    EventBatch,
+    StencilMatcher,
+)
 from kafkastreams_cep_tpu.parallel import BatchMatcher
 
 
@@ -102,6 +107,41 @@ def bench_engine(K, T, reps):
     return K * T / best
 
 
+def bench_stencil(total_events, reps):
+    """BASELINE.json config 2: strict-contiguity 3-stage SEQ over ~1M
+    synthetic StockEvents (stencil fast path; stderr-reported secondary)."""
+    pattern = (
+        Query()
+        .select("rise").where(lambda k, v, ts, st: v["price"] > 110)
+        .then()
+        .select("surge").where(lambda k, v, ts, st: v["volume"] > 900)
+        .then()
+        .select("drop").where(lambda k, v, ts, st: v["price"] < 105)
+        .build()
+    )
+    K = 128
+    T = max(total_events // K, 1)
+    m = StencilMatcher(pattern, K)
+    rng = np.random.default_rng(7)
+    events = make_batch(rng, K, T)
+    t0 = time.perf_counter()
+    _, out = m.scan(m.init_state(), events)
+    jax.block_until_ready(out.hit)
+    log(f"stencil: compile+first scan {time.perf_counter() - t0:.1f}s")
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        _, out = m.scan(m.init_state(), events)
+        jax.block_until_ready(out.hit)
+        best = min(best, time.perf_counter() - t0)
+    n_hits = int(jnp.sum(out.hit))
+    log(
+        f"stencil (strict 3-stage SEQ, {K}x{T} events): "
+        f"{K * T / best / 1e6:.1f}M ev/s, {n_hits} matches"
+    )
+    return K * T / best
+
+
 def bench_oracle(n_events):
     rng = np.random.default_rng(42)
     prices = rng.integers(90, 131, size=n_events)
@@ -131,6 +171,7 @@ def main():
     oracle_n = int(os.environ.get("CEP_BENCH_ORACLE_N", "4000"))
 
     parity_gate()
+    bench_stencil(int(os.environ.get("CEP_BENCH_STENCIL_N", "1048576")), reps)
     engine_evps = bench_engine(K, T, reps)
     oracle_evps = bench_oracle(oracle_n)
 

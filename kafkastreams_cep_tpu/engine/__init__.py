@@ -1,4 +1,5 @@
-"""Device NFA engine: the batched array matcher and its session wrapper."""
+"""Device NFA engine: the batched array matcher, the strict-SEQ stencil
+fast path, and the session wrapper."""
 
 from kafkastreams_cep_tpu.engine.matcher import (
     ArrayStates,
@@ -9,6 +10,11 @@ from kafkastreams_cep_tpu.engine.matcher import (
     StepOutput,
     TPUMatcher,
 )
+from kafkastreams_cep_tpu.engine.stencil import (
+    StencilMatcher,
+    StencilOutput,
+    StencilState,
+)
 
 __all__ = [
     "ArrayStates",
@@ -16,6 +22,9 @@ __all__ = [
     "EngineState",
     "EventBatch",
     "MatcherSession",
+    "StencilMatcher",
+    "StencilOutput",
+    "StencilState",
     "StepOutput",
     "TPUMatcher",
 ]

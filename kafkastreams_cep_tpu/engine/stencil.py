@@ -1,0 +1,160 @@
+"""Stencil matcher — the vectorized-over-time fast path for strict SEQ.
+
+For a branch-free pattern (every stage cardinality ONE, strict contiguity,
+no folds — ``TransitionTables.is_strict_seq``), the reference NFA's
+semantics collapse to a stencil: the begin stage re-seeds a run at every
+event (``NFA.java:148-157``), strict contiguity kills a run on the first
+non-matching event (no IGNORE edges, ``StatesFactory.java:93-96``), so a
+match completes at event ``t`` **iff** stage ``i``'s predicate holds on
+event ``t-n+1+i`` for all ``i``.  No run queue, no shared buffer, no
+versions — just ``n`` boolean arrays ANDed under relative shifts, fully
+parallel over keys *and* time (the general engine is sequential over time).
+
+``within()`` windows need no handling here for parity: in the reference all
+non-seed runs are epsilon wrappers that never carry ``windowMs``
+(``Stage.java:41-46``), so windows never prune (see ``engine/matcher.py``).
+
+A carry of the last ``n-1`` events' per-stage booleans and offsets makes
+matching exact across micro-batch boundaries.  Conformance: differential
+tests against :class:`OracleNFA` in ``tests/test_stencil.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafkastreams_cep_tpu.compiler.tables import (
+    OP_BEGIN,
+    TransitionTables,
+    lower,
+)
+from kafkastreams_cep_tpu.engine.matcher import ArrayStates, EventBatch
+
+
+class StencilState(NamedTuple):
+    """Carry across micro-batches: the trailing ``n-1`` valid events."""
+
+    bools: jnp.ndarray  # [K, n-1, n] bool — per-stage predicate values
+    offs: jnp.ndarray  # [K, n-1] int32 — event offsets (-1 = none yet)
+
+
+class StencilOutput(NamedTuple):
+    """``hit[k, t]`` = a match completed at batch slot ``t``;
+    ``offs[k, t, i]`` = the offset of the stage-``i`` event of that match."""
+
+    hit: jnp.ndarray  # [K, T] bool
+    offs: jnp.ndarray  # [K, T, n] int32
+
+
+class StencilMatcher:
+    """Compiled stencil matcher for one strict-SEQ pattern over ``K`` lanes.
+
+    ``scan(state, events)`` consumes a ``[K, T]`` :class:`EventBatch` whose
+    valid slots form a per-lane prefix (the processor's padding shape) and
+    returns every completed match.  Unlike :class:`TPUMatcher` there is no
+    sequential dependence on the time axis, so throughput is bounded by
+    memory bandwidth, not step latency.
+    """
+
+    def __init__(self, pattern, num_lanes: int):
+        self.tables: TransitionTables = (
+            pattern if isinstance(pattern, TransitionTables) else lower(pattern)
+        )
+        if not self.tables.is_strict_seq():
+            raise ValueError(
+                "pattern is not a branch-free strict sequence; use TPUMatcher"
+            )
+        self.num_lanes = int(num_lanes)
+        # Chain positions 0..n-1 each consume via a BEGIN edge; final is last.
+        n = self.tables.num_stages - 1
+        assert np.all(self.tables.consume_op[:n] == OP_BEGIN)
+        self.n = n
+        # Stage names in chain order, for decoding matches.
+        self.stage_names: List[str] = self.tables.names[:n]
+        self._preds = [
+            self.tables.predicates[self.tables.consume_pred[i]] for i in range(n)
+        ]
+        self.scan = jax.jit(self._scan)
+
+    def init_state(self) -> StencilState:
+        K, n = self.num_lanes, self.n
+        return StencilState(
+            bools=jnp.zeros((K, max(n - 1, 0), n), bool),
+            offs=jnp.full((K, max(n - 1, 0)), -1, jnp.int32),
+        )
+
+    def _scan(
+        self, state: StencilState, ev: EventBatch
+    ) -> Tuple[StencilState, StencilOutput]:
+        K, n = self.num_lanes, self.n
+        T = ev.ts.shape[-1]
+        states = ArrayStates({})
+        # [K, T, n]: every stage predicate on every event, one fused pass.
+        bools = jnp.stack(
+            [
+                jnp.broadcast_to(
+                    jnp.asarray(p(ev.key, ev.value, ev.ts, states), bool),
+                    (K, T),
+                )
+                & ev.valid
+                for p in self._preds
+            ],
+            axis=-1,
+        )
+        offs = jnp.asarray(ev.off, jnp.int32)
+
+        if n == 1:
+            out = StencilOutput(hit=bools[..., 0], offs=offs[..., None])
+            return state, out
+
+        ext_bools = jnp.concatenate([state.bools, bools], axis=1)  # [K, T+n-1, n]
+        ext_offs = jnp.concatenate([state.offs, offs], axis=1)  # [K, T+n-1]
+
+        # hit[k, t] = AND_i ext_bools[k, t+i, i]  (stage i saw event t-n+1+i).
+        hit = ext_bools[:, 0:T, 0]
+        for i in range(1, n):
+            hit = hit & ext_bools[:, i : i + T, i]
+        match_offs = jnp.stack(
+            [ext_offs[:, i : i + T] for i in range(n)], axis=-1
+        )
+
+        # New carry: the last n-1 *valid* columns.  Valid slots are a prefix
+        # of each lane's row, so they occupy ext columns [c, c+n-2] where c
+        # is the lane's valid count.
+        c = jnp.sum(ev.valid, axis=1).astype(jnp.int32)  # [K]
+        carry_bools = jax.vmap(
+            lambda row, start: jax.lax.dynamic_slice(
+                row, (start, 0), (n - 1, n)
+            )
+        )(ext_bools, c)
+        carry_offs = jax.vmap(
+            lambda row, start: jax.lax.dynamic_slice(row, (start,), (n - 1,))
+        )(ext_offs, c)
+
+        return StencilState(carry_bools, carry_offs), StencilOutput(hit, match_offs)
+
+    def decode(self, out: StencilOutput, events_by_offset, lane_keys=None):
+        """Host-side: materialize matches as ``Sequence`` objects per lane.
+
+        ``events_by_offset`` is a list (per lane) of ``{offset: Event}``.
+        Stages are inserted final-first, matching the reference's backward
+        buffer walk (``KVSharedVersionedBuffer.java:161``).
+        """
+        from kafkastreams_cep_tpu.utils.events import Sequence
+
+        hit = np.asarray(jax.device_get(out.hit))
+        offs = np.asarray(jax.device_get(out.offs))
+        matches = []
+        for k, t in zip(*np.nonzero(hit)):
+            seq = Sequence()
+            for i in range(self.n - 1, -1, -1):
+                seq.add(
+                    self.stage_names[i],
+                    events_by_offset[k][int(offs[k, t, i])],
+                )
+            matches.append((int(k), int(t), seq))
+        return matches

@@ -1,0 +1,117 @@
+"""Stencil matcher conformance: differential vs the oracle on random
+traces, including micro-batch boundary spans and ragged valid prefixes."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu import OracleNFA, Query
+from kafkastreams_cep_tpu.compiler.tables import lower
+from kafkastreams_cep_tpu.engine import EventBatch
+from kafkastreams_cep_tpu.engine.stencil import StencilMatcher
+
+
+def batch_of(codes, offs, valid):
+    codes = jnp.asarray(codes, jnp.int32)
+    K, T = codes.shape
+    return EventBatch(
+        key=jnp.zeros((K, T), jnp.int32),
+        value=codes,
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (K, T)),
+        off=jnp.asarray(offs, jnp.int32),
+        valid=jnp.asarray(valid, bool),
+    )
+
+
+def oracle_hits(pattern, trace):
+    """Per-event match offset-tuples from the oracle, first->last stage."""
+    oracle = OracleNFA.from_pattern(pattern)
+    hits = []
+    for i, v in enumerate(trace):
+        for m in oracle.match(None, int(v), 1000 + i, offset=i):
+            stages = list(reversed(list(m.as_map().items())))
+            hits.append(tuple(e.offset for _, events in stages for e in events))
+    return hits
+
+
+def stencil_hits(out, n):
+    hit = np.asarray(out.hit)
+    offs = np.asarray(out.offs)
+    return [
+        tuple(int(offs[k, t, i]) for i in range(n))
+        for k, t in zip(*np.nonzero(hit))
+    ]
+
+
+def test_rejects_non_strict_patterns():
+    with pytest.raises(ValueError, match="strict"):
+        StencilMatcher(sc.kleene_one_or_more(), 1)
+    with pytest.raises(ValueError, match="strict"):
+        StencilMatcher(sc.skip_till_any(), 1)
+    with pytest.raises(ValueError, match="strict"):
+        StencilMatcher(sc.stock_query(), 1)
+
+
+def test_is_strict_seq_accepts_strict3():
+    assert lower(sc.strict3()).is_strict_seq()
+
+
+def test_differential_single_batch():
+    rng = np.random.default_rng(21)
+    K, T = 16, 64
+    codes = rng.choice(5, size=(K, T), p=[0.4, 0.3, 0.2, 0.05, 0.05])
+    m = StencilMatcher(sc.strict3(), K)
+    offs = np.broadcast_to(np.arange(T), (K, T))
+    _, out = m.scan(m.init_state(), batch_of(codes, offs, np.ones((K, T), bool)))
+    got = sorted(stencil_hits(out, m.n))
+    want = []
+    for k in range(K):
+        want += oracle_hits(sc.strict3(), codes[k])
+    assert got == sorted(want)
+    assert len(got) > 0  # distribution chosen so matches actually occur
+
+
+def test_differential_across_batches_and_ragged():
+    """Matches spanning micro-batch boundaries are found via the carry;
+    ragged per-lane valid prefixes neither break nor fake contiguity."""
+    rng = np.random.default_rng(22)
+    K, total = 8, 96
+    codes = rng.choice(5, size=(K, total), p=[0.4, 0.3, 0.2, 0.05, 0.05])
+    # Force a boundary-spanning match in lane 0: A at 31, B at 32, C at 33.
+    codes[0, 31], codes[0, 32], codes[0, 33] = 0, 1, 2
+    m = StencilMatcher(sc.strict3(), K)
+    state = m.init_state()
+    got = []
+    consumed = np.zeros(K, dtype=int)
+    for start in (0, 32, 64):
+        T = 32
+        # Ragged: each lane consumes a different number of events this batch.
+        counts = rng.integers(T // 2, T + 1, size=K)
+        vals = np.zeros((K, T), dtype=np.int64)
+        offs = np.zeros((K, T), dtype=np.int64)
+        valid = np.zeros((K, T), dtype=bool)
+        for k in range(K):
+            c = int(counts[k])
+            c = min(c, total - consumed[k])
+            seg = codes[k, consumed[k] : consumed[k] + c]
+            vals[k, :c] = seg
+            offs[k, :c] = np.arange(consumed[k], consumed[k] + c)
+            valid[k, :c] = True
+            consumed[k] += c
+        state, out = m.scan(state, batch_of(vals, offs, valid))
+        got += stencil_hits(out, m.n)
+    want = []
+    for k in range(K):
+        want += oracle_hits(sc.strict3(), codes[k, : consumed[k]])
+    assert sorted(got) == sorted(want)
+    assert any(h == (31, 32, 33) for h in got)  # the forced boundary span
+
+
+def test_single_stage_pattern():
+    pattern = Query().select("only").where(lambda k, v, ts, st: v == 2).build()
+    m = StencilMatcher(pattern, 2)
+    codes = np.array([[2, 0, 2, 2], [0, 0, 0, 2]])
+    offs = np.broadcast_to(np.arange(4), (2, 4))
+    _, out = m.scan(m.init_state(), batch_of(codes, offs, np.ones((2, 4), bool)))
+    assert sorted(stencil_hits(out, 1)) == [(0,), (2,), (3,), (3,)]
