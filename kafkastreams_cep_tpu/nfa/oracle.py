@@ -203,7 +203,14 @@ class OracleNFA:
             else:
                 finals.extend(r for r in successors if r.is_forwarding_to_final())
             self.runs.extend(r for r in successors if not r.is_forwarding_to_final())
-        return [self.buffer.remove(r.stage, r.event, r.version) for r in finals]
+        matches = [self.buffer.remove(r.stage, r.event, r.version) for r in finals]
+        # Fold state is keyed (name, run id); drop entries for dead runs so
+        # state does not grow for the NFA's lifetime (the reference has the
+        # same leak, but its stores are RocksDB-backed).
+        live = {r.seq for r in self.runs}
+        for key_seq in [k for k in self._agg_state if k[1] not in live]:
+            del self._agg_state[key_seq]
+        return matches
 
     def _remove_pattern(self, run: Run) -> None:
         if run.event is not None:

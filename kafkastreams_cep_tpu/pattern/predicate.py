@@ -53,7 +53,9 @@ def _normalize(result):
     """
     if isinstance(result, bool):
         return result
-    if isinstance(result, (int, float)) and not hasattr(result, "shape"):
+    if not hasattr(result, "shape") and not hasattr(result, "dtype"):
+        # Any non-array host value (int, None, '', lists...): Python truth.
+        # Only traced/array values pass through to the bitwise path.
         return bool(result)
     return result
 

@@ -174,6 +174,43 @@ def test_complex_pattern_with_state():
     events = [Event(None, s, NOW, "test", 0, i) for i, s in enumerate(stocks)]
     matches = simulate(nfa, *events)
     assert len(matches) == 4
+    # Exact event content of all four matches, in emission order — the
+    # reference README documents these as e1..e8 JSON lines
+    # (/root/reference/README.md:93-96; stage names default to levels).
+    def canon(seq):
+        return {
+            stage: sorted(e.offset for e in evs)
+            for stage, evs in seq.as_map().items()
+        }
+
+    assert [canon(m) for m in matches] == [
+        {"0": [0], "1": [1, 2, 3, 4], "2": [5]},
+        {"0": [2], "1": [3], "2": [5]},
+        {"0": [0], "1": [1, 2, 3, 4, 5, 6], "2": [7]},
+        {"0": [2], "1": [3, 5], "2": [7]},
+    ]
+
+
+def test_independent_instances_per_partition():
+    """Per-partition ownership (CEPProcessor.java:117-134): one NFA per
+    partition, interleaved feeding, no cross-talk between instances."""
+    query = (
+        Query()
+        .select("a").where(value_is("A"))
+        .then()
+        .select("b").where(value_is("B"))
+        .build()
+    )
+    nfa_p0 = OracleNFA.from_pattern(query)
+    nfa_p1 = OracleNFA.from_pattern(query)
+    # p0 sees A then B (match); p1 sees B then A (no match) — interleaved.
+    out0, out1 = [], []
+    out0 += nfa_p0.match(None, "A", NOW, offset=0)
+    out1 += nfa_p1.match(None, "B", NOW, offset=0)
+    out0 += nfa_p0.match(None, "B", NOW + 1, offset=1)
+    out1 += nfa_p1.match(None, "A", NOW + 1, offset=1)
+    assert len(out0) == 1 and len(out1) == 0
+    assert [e.offset for e in out0[0].as_map()["b"]] == [1]
 
 
 def test_first_stage_skip_strategy_does_not_duplicate_begin_runs():
@@ -197,6 +234,25 @@ def test_first_stage_skip_strategy_does_not_duplicate_begin_runs():
         Event(None, "B", NOW + 101, "test", 0, 101),
     )
     assert len(matches) == 1
+
+
+def test_fold_state_pruned_for_dead_runs():
+    """Fold-state entries for dead runs are released each event (the
+    reference leaks these into RocksDB; the host oracle must not)."""
+    query = (
+        Query()
+        .select("a").where(value_is("A")).fold("n", lambda k, v, c: c + 1)
+        .then()
+        .select("b").where(value_is("B"))
+        .build()
+    )
+    nfa = OracleNFA.from_pattern(query)
+    for i in range(50):  # A runs start and die repeatedly (A then noise)
+        nfa.match(None, "A", NOW + 2 * i)
+        nfa.match(None, "X", NOW + 2 * i + 1)
+    live = {r.seq for r in nfa.runs}
+    assert all(seq in live for _, seq in nfa._agg_state)
+    assert len(nfa._agg_state) <= len(nfa.runs)
 
 
 def test_auto_offset_does_not_collide():

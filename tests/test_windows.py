@@ -1,0 +1,129 @@
+"""Window (``within``) semantics, both modes (VERDICT item 8).
+
+Faithful mode (default, oracle + engine): the reference never actually
+prunes on windows, because every non-seed run is an epsilon wrapper and
+``Stage.newEpsilonState`` does not copy ``windowMs`` (``Stage.java:41-46``),
+so ``ComputationStage.isOutOfWindow`` (``:98-100``) compares against ``-1``.
+These tests pin that quirk with genuinely advancing timestamps — the window
+is exceeded by orders of magnitude and matches still complete identically
+in the oracle and the array engine.
+
+Functional mode (``EngineConfig.enforce_windows=True``, engine-only
+deviation): runs are pruned using the evaluation stage's window, honouring
+the BEGIN window-start reset (``NFA.java:347-349``): a run whose identity
+stage is BEGIN-typed restarts its window at every event, so for a
+first-stage-cardinality-ONE pattern the clock effectively starts at the
+second event.
+"""
+
+import numpy as np
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu import OracleNFA, Query
+from kafkastreams_cep_tpu.engine import EngineConfig, MatcherSession, TPUMatcher
+
+A, B, C = sc.A, sc.B, sc.C
+
+
+def strict3_within(amount, unit):
+    return (
+        Query()
+        .select("first").where(sc.value_is(A))
+        .then()
+        .select("second").where(sc.value_is(B))
+        .then()
+        .select("latest").where(sc.value_is(C))
+        .within(amount, unit)
+        .build()
+    )
+
+
+def run_both(pattern, trace, config=None):
+    """(values, ts) trace through oracle and faithful engine; assert
+    identical per-event emission and return the canonical matches."""
+    oracle = OracleNFA.from_pattern(pattern)
+    sess = MatcherSession(TPUMatcher(pattern, config or sc.default_config()))
+    out = []
+    for i, (v, ts) in enumerate(trace):
+        o = oracle.match(None, v, ts, offset=i)
+        e = sess.match(None, v, ts, offset=i)
+        assert [sc.canon(m) for m in o] == [sc.canon(m) for m in e], f"event {i}"
+        out += [sc.canon(m) for m in o]
+    return out
+
+
+def test_faithful_mode_never_prunes_on_window():
+    """Timestamps advance far past the 5ms window; the reference (hence
+    oracle and engine) still completes the match — the quirk, pinned."""
+    trace = [(A, 1000), (B, 5000), (C, 9_000_000)]
+    matches = run_both(strict3_within(5, "ms"), trace)
+    assert matches == [{"first": [0], "second": [1], "latest": [2]}]
+
+
+def test_faithful_mode_stock_window_never_prunes():
+    """The stock demo's WITHIN 1h with events spread over 10 hours still
+    yields the reference's 4 matches in both implementations."""
+    pattern = sc.stock_query()
+    oracle = OracleNFA.from_pattern(pattern)
+    sess = MatcherSession(
+        TPUMatcher(pattern, sc.default_config(max_runs=32, slab_entries=64,
+                                              dewey_depth=16, max_walk=16))
+    )
+    hour = 3_600_000
+    o_all, e_all = [], []
+    for i, v in enumerate(sc.STOCKS):
+        ts = 1000 + i * hour + i  # >1h between consecutive events
+        o_all += oracle.match(None, v, ts, offset=i)
+        e_all += sess.match(None, v, ts, offset=i)
+    assert len(o_all) == len(e_all) == 4
+    assert [sc.canon(m) for m in o_all] == [sc.canon(m) for m in e_all]
+
+
+def enforce_cfg():
+    return EngineConfig(
+        max_runs=16, slab_entries=48, slab_preds=6, dewey_depth=10,
+        max_walk=10, enforce_windows=True,
+    )
+
+
+def run_enforced(pattern, trace):
+    sess = MatcherSession(TPUMatcher(pattern, enforce_cfg()))
+    out = []
+    for i, (v, ts) in enumerate(trace):
+        out += [sc.canon(m) for m in sess.match(None, v, ts, offset=i)]
+    return out
+
+
+def test_enforced_window_allows_in_window_match():
+    # Window start = second event (BEGIN reset quirk): C is 3ms after B.
+    trace = [(A, 1000), (B, 1001), (C, 1004)]
+    assert run_enforced(strict3_within(5, "ms"), trace) == [
+        {"first": [0], "second": [1], "latest": [2]}
+    ]
+
+
+def test_enforced_window_prunes_expired_run():
+    # C arrives 7ms after B: outside the 5ms window -> run pruned, no match.
+    trace = [(A, 1000), (B, 1001), (C, 1008)]
+    assert run_enforced(strict3_within(5, "ms"), trace) == []
+
+
+def test_enforced_window_begin_reset_starts_clock_at_second_event():
+    """A->B gap larger than the window does NOT kill the run (the consuming
+    run's identity stage is BEGIN-typed, so its window restarts every
+    event); only the B->C gap is measured."""
+    trace = [(A, 1000), (B, 1_000_000), (C, 1_000_003)]
+    assert run_enforced(strict3_within(5, "ms"), trace) == [
+        {"first": [0], "second": [1], "latest": [2]}
+    ]
+
+
+def test_enforced_window_prunes_then_new_match_still_possible():
+    """After a pruned run, later in-window events still match fresh runs."""
+    trace = [
+        (A, 1000), (B, 1001), (C, 1020),  # expired -> pruned
+        (A, 2000), (B, 2001), (C, 2003),  # fresh, in window
+    ]
+    assert run_enforced(strict3_within(5, "ms"), trace) == [
+        {"first": [3], "second": [4], "latest": [5]}
+    ]
