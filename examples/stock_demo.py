@@ -70,9 +70,9 @@ def format_match(seq, name_of) -> str:
     return json.dumps(obj, separators=(",", ":"))
 
 
-def run(processor=None):
-    """Feed the trace; return the JSON lines (shared with the test)."""
-    proc = processor or CEPProcessor(
+def make_processor() -> CEPProcessor:
+    """The demo's processor: 1 lane, capacity sized for the 8-event trace."""
+    return CEPProcessor(
         stock_pattern(),
         num_lanes=1,
         config=EngineConfig(
@@ -81,6 +81,11 @@ def run(processor=None):
         ),
         topic="StockEvents",
     )
+
+
+def run(processor=None):
+    """Feed the trace; return the JSON lines (shared with the test)."""
+    proc = processor or make_processor()
     name_of = {i: ev["name"] for i, ev in enumerate(STOCK_EVENTS)}
     records = [
         Record("stocks", {"price": ev["price"], "volume": ev["volume"]}, 1000 + i)
@@ -102,7 +107,34 @@ EXPECTED = [
 ]
 
 
+def run_stdin():
+    """Console-producer mode: JSON lines ``{"name","price","volume"}`` on
+    stdin (the README's input format, README.md:72-81), match JSON lines on
+    stdout — the full Kafka topic->topic demo loop without a broker."""
+    from kafkastreams_cep_tpu.utils.serde import json_serde
+
+    serde = json_serde()
+    proc = make_processor()
+    name_of = {}
+    i = 0
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if not raw:
+            continue
+        ev = serde.deserialize(raw.encode())
+        name_of[i] = ev["name"]
+        records = [
+            Record("stocks", {"price": ev["price"], "volume": ev["volume"]}, 1000 + i)
+        ]
+        for _, seq in proc.process(records):
+            print(format_match(seq, name_of), flush=True)
+        i += 1
+
+
 if __name__ == "__main__":
+    if "--stdin" in sys.argv:
+        run_stdin()
+        sys.exit(0)
     lines = run()
     for line in lines:
         print(line)

@@ -11,7 +11,16 @@ A ground-up re-design of the capabilities of ``vaquarkhan/kafkastreams-cep``
   (reference: ``nfa/NFA.java``),
 * a batched JAX/XLA array engine (``engine.TPUMatcher``) that steps the NFA
   over fixed-shape run/buffer state under ``jit``, vmapping over key lanes,
-  differentially tested against the oracle (``tests/test_engine_*.py``).
+  differentially tested against the oracle (``tests/test_engine_*.py``),
+* a vectorized-over-time stencil fast path for strict sequences
+  (``engine.StencilMatcher``),
+* single-chip key batching and multi-chip mesh sharding
+  (``parallel.BatchMatcher`` / ``parallel.ShardedMatcher``),
+* a host runtime with micro-batching, checkpoint/restore, and the stock
+  demo (``runtime.CEPProcessor``, ``runtime/checkpoint.py``,
+  ``examples/stock_demo.py``; reference: ``CEPProcessor.java``),
+* a benchmark harness (``bench.py``) and driver entries
+  (``__graft_entry__.py``).
 """
 
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
@@ -31,6 +40,15 @@ from kafkastreams_cep_tpu.engine.matcher import (
     MatcherSession,
     TPUMatcher,
 )
+from kafkastreams_cep_tpu.engine.stencil import StencilMatcher
+from kafkastreams_cep_tpu.parallel import BatchMatcher, ShardedMatcher, key_mesh
+from kafkastreams_cep_tpu.runtime import (
+    CEPProcessor,
+    Record,
+    restore_processor,
+    save_checkpoint,
+)
+from kafkastreams_cep_tpu.utils.logging import configure_logging
 
 __version__ = "0.2.0"
 
@@ -55,4 +73,13 @@ __all__ = [
     "EngineConfig",
     "MatcherSession",
     "TPUMatcher",
+    "StencilMatcher",
+    "BatchMatcher",
+    "ShardedMatcher",
+    "key_mesh",
+    "CEPProcessor",
+    "Record",
+    "save_checkpoint",
+    "restore_processor",
+    "configure_logging",
 ]

@@ -14,6 +14,7 @@ responsibilities are host-side Python around the batched device matcher:
 """
 
 from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
+from kafkastreams_cep_tpu.runtime.bank import CEPBank
 from kafkastreams_cep_tpu.runtime.checkpoint import (
     restore_processor,
     save_checkpoint,
@@ -21,6 +22,7 @@ from kafkastreams_cep_tpu.runtime.checkpoint import (
 )
 
 __all__ = [
+    "CEPBank",
     "CEPProcessor",
     "Record",
     "save_checkpoint",

@@ -1,0 +1,67 @@
+"""Multi-query bank: N patterns matched over the same stream.
+
+The reference runs multiple queries by wiring one ``CEPProcessor`` per
+pattern into the Kafka Streams topology, all consuming the same topic
+(``demo/CEPStockKStreamsDemo.java:55-72`` shows the single-processor
+wiring; multiple processors on one source is the documented composition).
+The TPU analog keeps that shape: a :class:`CEPBank` owns one
+:class:`CEPProcessor` per named query, feeds each the same micro-batch,
+and tags emissions with the query name.  Each query's device state is
+independent, so a bank's members can also be placed on *different* chips —
+the "multi-pattern NFA bank" axis of BASELINE.json config 4, the tensor-
+parallel analog from SURVEY §2.2.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, List, Optional, Sequence as Seq, Tuple
+
+from kafkastreams_cep_tpu.engine.matcher import EngineConfig
+from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
+from kafkastreams_cep_tpu.utils.events import Sequence
+
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.bank")
+
+
+class CEPBank:
+    """N independent queries over one stream of records.
+
+    ``patterns`` maps query name -> built :class:`Pattern`; every query
+    sees every record (same key->lane assignment rules per processor).
+    ``process`` returns ``(query_name, key, Sequence)`` triples — per
+    query in declaration order, each query's matches in its processor's
+    arrival order.
+    """
+
+    def __init__(
+        self,
+        patterns: Dict[str, object],
+        num_lanes: int,
+        config: Optional[EngineConfig] = None,
+        topic: str = "stream",
+        epoch: Optional[int] = None,
+    ):
+        if not patterns:
+            raise ValueError("a bank needs at least one pattern")
+        self.processors: Dict[str, CEPProcessor] = {
+            name: CEPProcessor(
+                pattern, num_lanes, config, topic=topic, epoch=epoch
+            )
+            for name, pattern in patterns.items()
+        }
+        logger.info("bank of %d queries: %s", len(patterns), list(patterns))
+
+    def process(
+        self, records: Seq[Record]
+    ) -> List[Tuple[str, Hashable, Sequence]]:
+        out: List[Tuple[str, Hashable, Sequence]] = []
+        for name, proc in self.processors.items():
+            out.extend(
+                (name, key, seq) for key, seq in proc.process(records)
+            )
+        return out
+
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        return {name: p.counters() for name, p in self.processors.items()}

@@ -32,6 +32,10 @@ import numpy as np
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EngineState
 from kafkastreams_cep_tpu.runtime.processor import CEPProcessor
 
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.checkpoint")
+
 FORMAT_VERSION = 1
 
 
@@ -88,6 +92,10 @@ def save_checkpoint(processor: CEPProcessor, path: str) -> None:
     np.savez(buf, **arrays)
     with open(path, "wb") as f:
         pickle.dump({"header": header, "arrays": buf.getvalue()}, f)
+    logger.info(
+        "checkpoint saved to %s: %d lanes, stages %s",
+        path, header["num_lanes"], header["stage_names"],
+    )
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
@@ -138,4 +146,8 @@ def restore_processor(pattern, path: str) -> CEPProcessor:
     proc._next_offset = np.asarray(header["next_offset"]).copy()
     proc._events = [dict(d) for d in header["events"]]
     proc._value_proto = header["value_proto"]
+    logger.info(
+        "restored processor from %s: %d keys assigned, offsets %s",
+        path, len(proc._lane_of), proc._next_offset.tolist(),
+    )
     return proc

@@ -22,7 +22,6 @@ matches on absolute time and your timestamps are small.
 
 from __future__ import annotations
 
-import logging
 from typing import Any, Dict, Hashable, List, NamedTuple, Optional, Sequence as Seq, Tuple
 
 import jax
@@ -32,8 +31,11 @@ import numpy as np
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EventBatch
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
+from kafkastreams_cep_tpu.utils.metrics import Metrics
 
-logger = logging.getLogger("kafkastreams_cep_tpu.runtime")
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime")
 
 _I32 = np.iinfo(np.int32)
 
@@ -102,6 +104,7 @@ class CEPProcessor:
         self._next_offset = np.zeros(self.num_lanes, dtype=np.int64)
         self._events: List[Dict[int, Event]] = [dict() for _ in range(self.num_lanes)]
         self._value_proto = None
+        self.metrics = Metrics()
 
     # -- key -> lane assignment (partition-assignment analog) ---------------
 
@@ -224,10 +227,16 @@ class CEPProcessor:
             valid=jnp.asarray(valid),
         )
 
-        self.state, out = self.batch.scan(self.state, events)
-        matches = self._decode(out, rank_of)
-        if self.gc_events:
-            self._gc_events()
+        with self.metrics.timed("device_seconds"):
+            self.state, out = self.batch.scan(self.state, events)
+            jax.block_until_ready(out.count)
+        with self.metrics.timed("decode_seconds"):
+            matches = self._decode(out, rank_of)
+            if self.gc_events:
+                self._gc_events()
+        self.metrics.records_in += len(records)
+        self.metrics.matches_out += len(matches)
+        self.metrics.batches += 1
         return matches
 
     def _decode(self, out, rank_of) -> List[Tuple[Hashable, Sequence]]:
@@ -273,3 +282,7 @@ class CEPProcessor:
     def counters(self) -> Dict[str, int]:
         """Lane-summed overflow/drop counters (all zero in healthy runs)."""
         return self.batch.counters(self.state)
+
+    def metrics_snapshot(self) -> Dict[str, float]:
+        """Runtime metrics + engine counters in one flat dict."""
+        return self.metrics.snapshot(self.counters())

@@ -1,0 +1,35 @@
+"""Logging configuration — the ``logback.xml`` analog.
+
+The reference ships a console logback config at INFO with DEBUG-level
+per-edge evaluation logs (``src/main/resources/logback.xml``,
+``NFA.java:180,232``).  Here the engine hot path is compiled, so per-edge
+logging is host-side only: lifecycle events (compiles, lane assignment,
+checkpoints) at INFO, decode details at DEBUG.  Library code only creates
+loggers; this helper is the opt-in console setup for applications.
+"""
+
+from __future__ import annotations
+
+import logging
+
+ROOT = "kafkastreams_cep_tpu"
+
+_FORMAT = "%(asctime)s %(levelname)-5s %(name)s - %(message)s"
+
+
+def configure_logging(level: int = logging.INFO) -> logging.Logger:
+    """Attach a console handler to the package root logger (idempotent)."""
+    logger = logging.getLogger(ROOT)
+    logger.setLevel(level)
+    # Exact-type check: FileHandler subclasses StreamHandler and must not
+    # suppress the console handler this function owns.
+    if not any(type(h) is logging.StreamHandler for h in logger.handlers):
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+    return logger
+
+
+def get_logger(name: str) -> logging.Logger:
+    """A child logger under the package root."""
+    return logging.getLogger(f"{ROOT}.{name}")

@@ -1,0 +1,74 @@
+"""Metrics & tracing — the ``StreamsMetrics`` analog the reference skips.
+
+The reference exposes Kafka Streams' metrics registry via the processor
+context but never records anything (SURVEY §5); here the runtime keeps real
+counters (records, matches, batches, device wall time) and the engine's
+overflow diagnostics are pulled into the same snapshot.  ``profile``
+wraps ``jax.profiler`` so a processor window can be captured for
+TensorBoard/XProf when tuning on real TPU hardware.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator
+
+
+@dataclass
+class Metrics:
+    """Mutable counters for one processor (or bank member)."""
+
+    records_in: int = 0
+    matches_out: int = 0
+    batches: int = 0
+    device_seconds: float = 0.0
+    decode_seconds: float = 0.0
+
+    def snapshot(self, engine_counters: Dict[str, int]) -> Dict[str, float]:
+        """One flat dict: runtime counters + engine overflow counters +
+        derived rates."""
+        out: Dict[str, float] = {
+            "records_in": self.records_in,
+            "matches_out": self.matches_out,
+            "batches": self.batches,
+            "device_seconds": round(self.device_seconds, 6),
+            "decode_seconds": round(self.decode_seconds, 6),
+        }
+        if self.device_seconds > 0:
+            out["events_per_second_device"] = round(
+                self.records_in / self.device_seconds, 1
+            )
+        out.update(engine_counters)
+        return out
+
+    @contextlib.contextmanager
+    def timed(self, attr: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            setattr(self, attr, getattr(self, attr) + time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace of the enclosed block (viewable in
+    TensorBoard/XProf); use around ``processor.process`` calls on TPU."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a host-side region inside an active profiler trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

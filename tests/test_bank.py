@@ -1,0 +1,33 @@
+"""Multi-query bank: N patterns over one stream, independent state."""
+
+import pytest
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu.runtime import CEPBank, Record
+
+
+def test_bank_runs_queries_independently():
+    bank = CEPBank(
+        {"strict": sc.strict3(), "skip": sc.skip_till_next()},
+        num_lanes=2,
+        config=sc.default_config(),
+    )
+    # A B C D: strict3 matches ABC contiguously; skip_till_next matches
+    # A..C..D skipping B.
+    records = [
+        Record("k", v, 1000 + i) for i, v in enumerate([sc.A, sc.B, sc.C, sc.D])
+    ]
+    out = bank.process(records)
+    by_query = {}
+    for name, key, seq in out:
+        by_query.setdefault(name, []).append(sc.canon(seq))
+    assert by_query["strict"] == [{"first": [0], "second": [1], "latest": [2]}]
+    assert by_query["skip"] == [{"first": [0], "second": [2], "latest": [3]}]
+    counters = bank.counters()
+    assert set(counters) == {"strict", "skip"}
+    assert all(v == 0 for c in counters.values() for v in c.values())
+
+
+def test_bank_rejects_empty():
+    with pytest.raises(ValueError, match="at least one"):
+        CEPBank({}, num_lanes=1)

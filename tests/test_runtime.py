@@ -172,6 +172,22 @@ def test_checkpoint_restore_mid_trace(tmp_path):
     assert lines == stock_demo.EXPECTED
 
 
+def test_processor_metrics_snapshot():
+    proc = CEPProcessor(stock_demo.stock_pattern(), 1, stock_cfg())
+    records = [
+        Record("s", {"price": e["price"], "volume": e["volume"]}, 1000 + i)
+        for i, e in enumerate(stock_demo.STOCK_EVENTS)
+    ]
+    proc.process(records[:4])
+    proc.process(records[4:])
+    snap = proc.metrics_snapshot()
+    assert snap["records_in"] == 8
+    assert snap["matches_out"] == 4
+    assert snap["batches"] == 2
+    assert snap["device_seconds"] > 0
+    assert snap["run_drops"] == 0
+
+
 def test_checkpoint_refuses_wrong_topology(tmp_path):
     proc = CEPProcessor(sc.strict3(), 1, sc.default_config())
     proc.process([Record("k", 0, 1)])
