@@ -77,6 +77,10 @@ from kafkastreams_cep_tpu.ops import slab as slab_mod
 from kafkastreams_cep_tpu.pattern.pattern import Pattern
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("engine")
+
 
 class ArrayStates:
     """Read-only fold-state view handed to predicates on device.
@@ -617,6 +621,11 @@ class TPUMatcher:
             pattern if isinstance(pattern, TransitionTables) else lower(pattern)
         )
         self.config = config or EngineConfig()
+        logger.info(
+            "building matcher: %d stages %s, max_hops=%d, %s",
+            self.tables.num_stages, self.tables.names,
+            self.tables.max_hops, self.config,
+        )
         step, init_state = _build_step(self.tables, self.config)
         self._step_fn = step
         self._init_fn = init_state
