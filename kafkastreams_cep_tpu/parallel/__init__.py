@@ -14,6 +14,12 @@ fixed-shape engine state, so
 """
 
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+from kafkastreams_cep_tpu.parallel.seqpar import TimeShardedStencil
 from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher, key_mesh
 
-__all__ = ["BatchMatcher", "ShardedMatcher", "key_mesh"]
+__all__ = [
+    "BatchMatcher",
+    "ShardedMatcher",
+    "TimeShardedStencil",
+    "key_mesh",
+]

@@ -83,6 +83,7 @@ def save_checkpoint(processor: CEPProcessor, path: str) -> None:
         "topic": processor.topic,
         "epoch": processor.epoch,
         "gc_events": processor.gc_events,
+        "dedup": processor.dedup,
         "lane_of": dict(processor._lane_of),
         "next_offset": processor._next_offset.copy(),
         "events": [dict(d) for d in processor._events],
@@ -130,6 +131,7 @@ def restore_processor(pattern, path: str) -> CEPProcessor:
         topic=header["topic"],
         epoch=header["epoch"],
         gc_events=header["gc_events"],
+        dedup=header["dedup"],
     )
     if list(proc.batch.names) != list(header["stage_names"]):
         raise ValueError(

@@ -41,11 +41,18 @@ _I32 = np.iinfo(np.int32)
 
 
 class Record(NamedTuple):
-    """One input record, the host analog of a Kafka ``(key, value, ts)``."""
+    """One input record, the host analog of a Kafka ``(key, value, ts)``.
+
+    ``offset`` is the record's log position within its key's lane: pass the
+    source offset (Kafka-style) to enable replay dedup, or leave ``None``
+    for auto-assignment.  Mixing explicit and auto offsets within one lane
+    is allowed but auto always continues past the highest seen.
+    """
 
     key: Hashable
     value: Any
     timestamp: int
+    offset: Optional[int] = None
 
 
 def _bucket(t: int) -> int:
@@ -75,6 +82,12 @@ class CEPProcessor:
     index (keys must then not be matched on — the reference's lambdas can
     close over arbitrary keys, a device program cannot).
 
+    **At-least-once dedup (deviation — fixes reference README.md:108).**
+    The reference corrupts runs when records replay; here each lane keeps a
+    high-water mark, and a record whose explicit ``offset`` is below it is
+    dropped (counted in ``metrics.duplicates_dropped``).  Pass
+    ``dedup=False`` to reproduce the reference's replay behavior.
+
     ``process(records)`` accepts any number of records, splits them into
     per-lane queues, pads to the max queue length (bucketed to powers of
     two so jit retraces are bounded), scans the whole batch in one jitted
@@ -92,6 +105,7 @@ class CEPProcessor:
         topic: str = "stream",
         epoch: Optional[int] = None,
         gc_events: bool = True,
+        dedup: bool = True,
     ):
         self.batch = BatchMatcher(pattern, num_lanes, config)
         self.topic = topic
@@ -99,6 +113,7 @@ class CEPProcessor:
         self.state = self.batch.init_state()
         self.epoch = epoch  # None = rebase to the first record's timestamp
         self.gc_events = gc_events
+        self.dedup = dedup
         self._lane_of: Dict[Hashable, int] = {}
         self._key_of: Dict[int, Hashable] = {}
         self._next_offset = np.zeros(self.num_lanes, dtype=np.int64)
@@ -164,8 +179,12 @@ class CEPProcessor:
 
         # Validate the whole batch BEFORE mutating any lane bookkeeping, so
         # a bad record rejects the batch atomically (nothing half-ingested).
+        # Offsets are simulated here too: explicit ones below the lane's
+        # high-water mark are duplicates (at-least-once replay) and dropped.
         lanes = [self.lane(rec.key) for rec in records]
         rel_ts = [self._rebased_ts(rec.timestamp) for rec in records]
+        next_sim = self._next_offset.copy()
+        offsets: List[Optional[int]] = []
         batch_leaves = []
         for rank, rec in enumerate(records):
             leaves = jax.tree_util.tree_leaves(rec.value)
@@ -181,20 +200,37 @@ class CEPProcessor:
                         "schema (fixed by the first record) typed as int"
                     )
             batch_leaves.append(leaves)
+            lane = lanes[rank]
+            off = rec.offset if rec.offset is not None else int(next_sim[lane])
+            if self.dedup and off < next_sim[lane]:
+                offsets.append(None)  # duplicate — high-water mark drop
+            else:
+                offsets.append(off)
+                next_sim[lane] = max(next_sim[lane], off + 1)
 
         # Group into per-lane queues, remembering each record's arrival rank.
         queues: List[List[int]] = [[] for _ in range(K)]
-        events_by_rank: List[Event] = []
+        events_by_rank: List[Optional[Event]] = []
+        dropped = 0
         for rank, rec in enumerate(records):
+            off = offsets[rank]
+            if off is None:
+                events_by_rank.append(None)
+                dropped += 1
+                continue
             lane = lanes[rank]
-            off = int(self._next_offset[lane])
-            self._next_offset[lane] += 1
+            self._next_offset[lane] = max(self._next_offset[lane], off + 1)
             event = Event(
                 rec.key, rec.value, int(rec.timestamp), self.topic, lane, off
             )
             self._events[lane][off] = event
             events_by_rank.append(event)
             queues[lane].append(rank)
+        self.metrics.duplicates_dropped += dropped
+        if dropped:
+            logger.info("dropped %d replayed records (high-water mark)", dropped)
+        if all(off is None for off in offsets):
+            return []
 
         T = _bucket(max(len(q) for q in queues))
 
@@ -234,7 +270,7 @@ class CEPProcessor:
             matches = self._decode(out, rank_of)
             if self.gc_events:
                 self._gc_events()
-        self.metrics.records_in += len(records)
+        self.metrics.records_in += len(records) - dropped
         self.metrics.matches_out += len(matches)
         self.metrics.batches += 1
         return matches

@@ -23,6 +23,7 @@ class Metrics:
     records_in: int = 0
     matches_out: int = 0
     batches: int = 0
+    duplicates_dropped: int = 0
     device_seconds: float = 0.0
     decode_seconds: float = 0.0
 
@@ -33,6 +34,7 @@ class Metrics:
             "records_in": self.records_in,
             "matches_out": self.matches_out,
             "batches": self.batches,
+            "duplicates_dropped": self.duplicates_dropped,
             "device_seconds": round(self.device_seconds, 6),
             "decode_seconds": round(self.decode_seconds, 6),
         }

@@ -172,6 +172,38 @@ def test_checkpoint_restore_mid_trace(tmp_path):
     assert lines == stock_demo.EXPECTED
 
 
+def test_replay_dedup_high_water_mark():
+    """At-least-once replays are dropped (deviation fixing the reference's
+    documented gap, README.md:108): resending processed offsets neither
+    duplicates matches nor corrupts runs."""
+    proc = CEPProcessor(sc.strict3(), 1, sc.default_config())
+    first = [
+        Record("k", sc.A, 1, offset=10),
+        Record("k", sc.B, 2, offset=11),
+    ]
+    assert proc.process(first) == []
+    # Replay the same offsets plus the completing event.
+    out = proc.process(first + [Record("k", sc.C, 3, offset=12)])
+    assert len(out) == 1
+    assert proc.metrics.duplicates_dropped == 2
+    # Full replay of everything: no new matches at all.
+    assert proc.process(first + [Record("k", sc.C, 3, offset=12)]) == []
+    assert proc.metrics.duplicates_dropped == 5
+
+
+def test_replay_duplicates_without_dedup_mimics_reference():
+    """dedup=False reproduces the reference's replay behavior: duplicated
+    offsets re-enter the NFA (matches duplicate — the documented gap)."""
+    proc = CEPProcessor(sc.strict3(), 1, sc.default_config(), dedup=False)
+    trace = [
+        Record("k", sc.A, 1, offset=0),
+        Record("k", sc.B, 2, offset=1),
+        Record("k", sc.C, 3, offset=2),
+    ]
+    assert len(proc.process(trace)) == 1
+    assert len(proc.process(trace)) >= 1  # replay produces matches again
+
+
 def test_processor_metrics_snapshot():
     proc = CEPProcessor(stock_demo.stock_pattern(), 1, stock_cfg())
     records = [
