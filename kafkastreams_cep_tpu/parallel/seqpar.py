@@ -32,11 +32,12 @@ from kafkastreams_cep_tpu.engine.stencil import StencilMatcher, StencilOutput
 class TimeShardedStencil:
     """Strict-SEQ matching with the time axis sharded over a mesh.
 
-    ``match(events)`` consumes a fully-valid ``[K, T]`` batch with ``T``
-    divisible by the mesh size; every device stencils its own ``T/n_dev``
-    chunk after one boundary exchange.  Output shapes equal the
-    single-device :class:`StencilMatcher` scan on the same batch — verified
-    equal element-for-element in ``tests/test_seqpar.py``.
+    ``match(events)`` consumes a ``[K, T]`` batch with ``T`` divisible by
+    the mesh size (padding slots are masked via ``valid``, exactly like the
+    single-device scan); every device stencils its own ``T/n_dev`` chunk
+    after one boundary exchange.  Output shapes equal the single-device
+    :class:`StencilMatcher` scan on the same batch — verified equal
+    element-for-element in ``tests/test_seqpar.py``.
     """
 
     def __init__(self, pattern, num_lanes: int, mesh: Mesh):
@@ -49,7 +50,7 @@ class TimeShardedStencil:
         preds = self.inner._preds
         axis = self.axis
 
-        def local(key, value, ts, off):
+        def local(key, value, ts, off, valid):
             # [K, Tc] local chunk -> per-stage bools, halo, stencil.
             K = key.shape[0]
             Tc = key.shape[1]
@@ -59,6 +60,7 @@ class TimeShardedStencil:
                     jnp.broadcast_to(
                         jnp.asarray(p(key, value, ts, states), bool), (K, Tc)
                     )
+                    & valid
                     for p in preds
                 ],
                 axis=-1,
@@ -82,7 +84,10 @@ class TimeShardedStencil:
             )
             return hit, match_offs
 
-        spec_in = (P(None, axis), P(None, axis), P(None, axis), P(None, axis))
+        spec_in = (
+            P(None, axis), P(None, axis), P(None, axis), P(None, axis),
+            P(None, axis),
+        )
         spec_out = (P(None, axis), P(None, axis, None))
         self._match = jax.jit(
             jax.shard_map(
@@ -107,5 +112,7 @@ class TimeShardedStencil:
             raise ValueError(
                 f"time axis {T} not divisible by mesh size {self.n_dev}"
             )
-        hit, offs = self._match(events.key, events.value, events.ts, events.off)
+        hit, offs = self._match(
+            events.key, events.value, events.ts, events.off, events.valid
+        )
         return StencilOutput(hit=hit, offs=offs)

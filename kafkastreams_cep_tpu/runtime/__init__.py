@@ -10,7 +10,10 @@ responsibilities are host-side Python around the batched device matcher:
   arrival order (``runtime/processor.py``);
 * :mod:`runtime.checkpoint` — snapshot/restore of the device state arrays
   with stages referenced by name only, so code never serializes
-  (``ComputationStageSerDe.java:40-123`` contract).
+  (``ComputationStageSerDe.java:40-123`` contract);
+* :mod:`runtime.supervisor` — failure detection and auto-recovery
+  (checkpoint + journal replay), the rebalance/changelog-restore analog
+  the reference inherits from Kafka Streams (SURVEY §5).
 """
 
 from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
@@ -20,11 +23,19 @@ from kafkastreams_cep_tpu.runtime.checkpoint import (
     save_checkpoint,
     load_checkpoint,
 )
+from kafkastreams_cep_tpu.runtime.supervisor import (
+    HealthReport,
+    Supervisor,
+    check_health,
+)
 
 __all__ = [
     "CEPBank",
     "CEPProcessor",
+    "HealthReport",
     "Record",
+    "Supervisor",
+    "check_health",
     "save_checkpoint",
     "load_checkpoint",
     "restore_processor",

@@ -130,8 +130,8 @@ def restore_processor(pattern, path: str) -> CEPProcessor:
         config,
         topic=header["topic"],
         epoch=header["epoch"],
-        gc_events=header["gc_events"],
-        dedup=header["dedup"],
+        gc_events=header.get("gc_events", True),
+        dedup=header.get("dedup", True),
     )
     if list(proc.batch.names) != list(header["stage_names"]):
         raise ValueError(

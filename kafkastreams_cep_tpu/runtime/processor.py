@@ -179,9 +179,24 @@ class CEPProcessor:
 
         # Validate the whole batch BEFORE mutating any lane bookkeeping, so
         # a bad record rejects the batch atomically (nothing half-ingested).
-        # Offsets are simulated here too: explicit ones below the lane's
+        # Lane assignment is simulated first and committed only after
+        # validation — a rejected batch must not consume lane slots.
+        # Offsets are simulated the same way: explicit ones below the lane's
         # high-water mark are duplicates (at-least-once replay) and dropped.
-        lanes = [self.lane(rec.key) for rec in records]
+        lane_sim = dict(self._lane_of)
+        lanes = []
+        for rec in records:
+            lane = lane_sim.get(rec.key)
+            if lane is None:
+                lane = len(lane_sim)
+                if lane >= self.num_lanes:
+                    raise ValueError(
+                        f"more than num_lanes={self.num_lanes} distinct "
+                        "keys; size the processor for the key cardinality "
+                        "it serves"
+                    )
+                lane_sim[rec.key] = lane
+            lanes.append(lane)
         rel_ts = [self._rebased_ts(rec.timestamp) for rec in records]
         next_sim = self._next_offset.copy()
         offsets: List[Optional[int]] = []
@@ -207,6 +222,13 @@ class CEPProcessor:
             else:
                 offsets.append(off)
                 next_sim[lane] = max(next_sim[lane], off + 1)
+
+        # Validation passed — commit the simulated lane assignments.
+        for key, lane in lane_sim.items():
+            if key not in self._lane_of:
+                self._lane_of[key] = lane
+                self._key_of[lane] = key
+                logger.info("assigned key %r to lane %d", key, lane)
 
         # Group into per-lane queues, remembering each record's arrival rank.
         queues: List[List[int]] = [[] for _ in range(K)]

@@ -1,0 +1,228 @@
+"""Failure detection & recovery — the rebalance/changelog-restore analog.
+
+The reference delegates fault tolerance entirely to Kafka Streams (SURVEY
+§5): every store is changelog-backed, so when a task dies the partition is
+reassigned and the new owner replays the changelog to rebuild run queue,
+buffer, and aggregate state (``CEPProcessor.java:117-134,144-149``).  The
+library's own contribution is keeping *all* engine state store-resident so
+that recovery is possible at every record boundary.
+
+The TPU analog splits the same contract in two:
+
+* **checkpoint** = the changelog snapshot: the supervisor persists the
+  processor's full state (``runtime/checkpoint.py``) every
+  ``checkpoint_every`` batches — far cheaper than the reference's
+  every-record run-queue serialization (``CEPProcessor.java:158-160``),
+  with the gap covered by a record journal;
+* **journal + replay** = the changelog tail: records processed since the
+  last checkpoint are kept host-side; on failure the supervisor restores
+  the checkpoint and replays the journal, which is deterministic (the
+  engine is a pure function of state × records), so the recovered
+  processor lands in exactly the pre-failure state.
+
+Failure *detection* covers what a lost Kafka Streams task would surface:
+any exception out of the device dispatch (device reset, OOM, tunnel loss)
+triggers recovery, and :meth:`Supervisor.health` exposes the engine's
+overflow counters plus state-validity probes (NaN fold state, negative
+refcounts) as a typed report — the counters exist precisely because
+fixed-shape capacity overflow is this design's failure mode, with no
+reference analog to inherit.
+
+Matches replayed during recovery are suppressed (they were already
+emitted), preserving exactly-once *emission* for everything the caller saw
+before the failure — one better than the reference, whose at-least-once
+replay duplicates and corrupts runs (``README.md:108``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Hashable, List, Optional, Sequence as Seq, Tuple
+
+import numpy as np
+
+from kafkastreams_cep_tpu.engine.matcher import EngineConfig
+from kafkastreams_cep_tpu.runtime import checkpoint as ckpt_mod
+from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
+from kafkastreams_cep_tpu.utils.events import Sequence
+
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.supervisor")
+
+
+@dataclass
+class HealthReport:
+    """One health probe of a live processor."""
+
+    healthy: bool
+    warnings: List[str] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+    counters: dict = field(default_factory=dict)
+
+
+def check_health(processor: CEPProcessor) -> HealthReport:
+    """Probe a processor's engine state for capacity loss and corruption.
+
+    *Warnings* are capacity-policy events (bounded-shape drops: runs, slab
+    entries, pointer lists, Dewey width, walk length) — matching may have
+    silently lost branches, which the reference (unbounded heap) never
+    does; *errors* are states no healthy execution can reach (NaN fold
+    state, negative refcounts) and indicate corruption.
+    """
+    counters = processor.counters()
+    warnings = [
+        f"{name}={val} capacity drops" for name, val in counters.items() if val
+    ]
+    errors = []
+    agg = np.asarray(processor.state.agg)
+    if np.isnan(agg).any():
+        errors.append("NaN in fold-aggregate state")
+    refs = np.asarray(processor.state.slab.refs)
+    if (refs < 0).any():
+        errors.append("negative slab refcount")
+    return HealthReport(
+        healthy=not errors, warnings=warnings, errors=errors, counters=counters
+    )
+
+
+class Supervisor:
+    """Checkpointing, health-probing, auto-recovering processor wrapper.
+
+    ``pattern`` must be re-compilable user code (predicates/folds live in
+    code, never in checkpoints — the ``ComputationStageSerDe`` contract);
+    the supervisor owns the processor it creates.
+
+    ``process(records)`` behaves like :meth:`CEPProcessor.process`, plus:
+
+    * every ``checkpoint_every`` batches the full state is checkpointed
+      (atomic rename, so a crash mid-write keeps the previous snapshot);
+    * if the underlying processor raises, the supervisor restores the
+      latest checkpoint, replays the journaled records since it
+      (suppressing their already-emitted matches), retries the failing
+      batch once, and counts the recovery in ``recoveries``.
+    """
+
+    _instance_ids = itertools.count()
+
+    def __init__(
+        self,
+        pattern,
+        num_lanes: int,
+        config: Optional[EngineConfig] = None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 16,
+        max_retries: int = 1,
+        **proc_kwargs,
+    ):
+        self._pattern = pattern
+        self._proc_kwargs = dict(proc_kwargs)
+        self.processor = CEPProcessor(
+            pattern, num_lanes, config, **self._proc_kwargs
+        )
+        # Per-instance default path: two supervisors in one process must
+        # never clobber each other's snapshots.
+        self.checkpoint_path = checkpoint_path or os.path.join(
+            tempfile.gettempdir(),
+            f"cep_supervisor_{os.getpid()}_{next(self._instance_ids)}.ckpt",
+        )
+        self.checkpoint_every = int(checkpoint_every)
+        self.max_retries = int(max_retries)
+        self._journal: List[List[Record]] = []  # batches since last ckpt
+        self._has_checkpoint = False
+        self._batches_since_ckpt = 0
+        self.recoveries = 0
+        self.checkpoints = 0
+        self.checkpoint_failures = 0
+
+    # -- checkpointing ------------------------------------------------------
+
+    def checkpoint(self) -> None:
+        """Snapshot now (atomic) and truncate the journal."""
+        tmp = self.checkpoint_path + ".tmp"
+        ckpt_mod.save_checkpoint(self.processor, tmp)
+        os.replace(tmp, self.checkpoint_path)
+        self._has_checkpoint = True
+        self._journal.clear()
+        self._batches_since_ckpt = 0
+        self.checkpoints += 1
+
+    # -- the supervised hot path -------------------------------------------
+
+    def process(
+        self, records: Seq[Record]
+    ) -> List[Tuple[Hashable, Sequence]]:
+        records = list(records)
+        for attempt in range(self.max_retries + 1):
+            try:
+                matches = self.processor.process(records)
+                break
+            except ValueError:
+                # Deterministic input rejection (schema, lane overflow,
+                # timestamp range): the batch is bad, not the device —
+                # restore-and-replay cannot help and state was untouched
+                # (processor validation is atomic).
+                raise
+            except Exception:
+                if attempt >= self.max_retries:
+                    raise
+                logger.exception(
+                    "processor failed on a %d-record batch; recovering",
+                    len(records),
+                )
+                self._recover()
+        self._journal.append(records)
+        self._batches_since_ckpt += 1
+        if self._batches_since_ckpt >= self.checkpoint_every:
+            # A failed snapshot (disk full, ...) must not lose the batch's
+            # matches: the journal still covers everything since the last
+            # good snapshot, so log, count, and retry next batch.
+            try:
+                self.checkpoint()
+            except Exception:
+                self.checkpoint_failures += 1
+                logger.exception("checkpoint failed; journal retained")
+        return matches
+
+    def _recover(self) -> None:
+        """Restore the last checkpoint and replay the journal tail.
+
+        Replay is deterministic, so the processor lands in exactly the
+        state it had after the last successful batch; replayed matches are
+        dropped (already emitted).  With no checkpoint yet, the journal is
+        the full history and replay starts from a fresh processor.
+        """
+        if self._has_checkpoint:
+            self.processor = ckpt_mod.restore_processor(
+                self._pattern, self.checkpoint_path
+            )
+        else:
+            num_lanes = self.processor.num_lanes
+            config = self.processor.batch.matcher.config
+            self.processor = CEPProcessor(
+                self._pattern, num_lanes, config, **self._proc_kwargs
+            )
+        replayed = 0
+        for batch in self._journal:
+            self.processor.process(batch)  # matches already emitted
+            replayed += len(batch)
+        self.recoveries += 1
+        logger.info(
+            "recovered: checkpoint=%s, %d journaled records replayed",
+            self._has_checkpoint, replayed,
+        )
+
+    # -- diagnostics --------------------------------------------------------
+
+    def health(self) -> HealthReport:
+        return check_health(self.processor)
+
+    def metrics_snapshot(self) -> dict:
+        out = self.processor.metrics_snapshot()
+        out["recoveries"] = self.recoveries
+        out["checkpoints"] = self.checkpoints
+        out["checkpoint_failures"] = self.checkpoint_failures
+        return out

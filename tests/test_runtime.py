@@ -80,6 +80,17 @@ def test_processor_key_overflow_raises():
         proc.process([Record("c", 0, 3)])
 
 
+def test_rejected_batch_does_not_leak_lane_slots():
+    """A batch rejected during validation consumes no lane slots: the same
+    new keys can be ingested later in a valid batch."""
+    proc = CEPProcessor(sc.strict3(), 2, sc.default_config())
+    with pytest.raises(ValueError, match="num_lanes"):
+        proc.process([Record("a", 0, 1), Record("b", 0, 2), Record("c", 0, 3)])
+    assert proc._lane_of == {}
+    proc.process([Record("a", 0, 1), Record("b", 0, 2)])  # both fit now
+    assert set(proc._lane_of) == {"a", "b"}
+
+
 def test_processor_key_overflow_is_atomic():
     """A rejected batch ingests nothing: the valid record in it is not
     half-processed, and resubmitting it alone still works."""
