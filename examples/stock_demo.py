@@ -110,25 +110,59 @@ EXPECTED = [
 def run_stdin():
     """Console-producer mode: JSON lines ``{"name","price","volume"}`` on
     stdin (the README's input format, README.md:72-81), match JSON lines on
-    stdout — the full Kafka topic->topic demo loop without a broker."""
+    stdout — the full Kafka topic->topic demo loop without a broker.
+
+    Parsing goes through the native C++ fast path
+    (``native.parse_json_lines``) in micro-batches, with the full JSON
+    serde as the per-line fallback — the production ingest shape.
+    """
+    from kafkastreams_cep_tpu import native
     from kafkastreams_cep_tpu.utils.serde import json_serde
 
     serde = json_serde()
     proc = make_processor()
     name_of = {}
     i = 0
+    chunk: list = []
+
+    def flush_chunk():
+        nonlocal i
+        if not chunk:
+            return
+        text = "\n".join(chunk).encode()
+        values, keys, ok = native.parse_json_lines(
+            text, ["price", "volume"], key_field="name"
+        )
+        records = []
+        for j, raw in enumerate(chunk):
+            if ok[j]:
+                name, price, volume = keys[j], values[j, 0], values[j, 1]
+            else:  # fast path rejected the line — full JSON fallback
+                ev = serde.deserialize(raw.encode())
+                name, price, volume = ev["name"], ev["price"], ev["volume"]
+            name_of[i] = name
+            # Preserve the JSON number type: integral -> int (the demo's
+            # schema), fractional -> float.
+            price = int(price) if float(price).is_integer() else float(price)
+            volume = (
+                int(volume) if float(volume).is_integer() else float(volume)
+            )
+            records.append(
+                Record("stocks", {"price": price, "volume": volume}, 1000 + i)
+            )
+            i += 1
+        for _, seq in proc.process(records):
+            print(format_match(seq, name_of), flush=True)
+        chunk.clear()
+
     for raw in sys.stdin:
         raw = raw.strip()
         if not raw:
             continue
-        ev = serde.deserialize(raw.encode())
-        name_of[i] = ev["name"]
-        records = [
-            Record("stocks", {"price": ev["price"], "volume": ev["volume"]}, 1000 + i)
-        ]
-        for _, seq in proc.process(records):
-            print(format_match(seq, name_of), flush=True)
-        i += 1
+        chunk.append(raw)
+        if len(chunk) >= 64:
+            flush_chunk()
+    flush_chunk()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,350 @@
+"""Native host-runtime bindings: C++ batch packing + JSON-lines parsing.
+
+The compute path is JAX/XLA (``engine/``); the host runtime around it —
+grouping micro-batches into lanes, scattering columns into device-ready
+``[K, T]`` grids, and parsing the JSON ingest boundary — is native C++
+(``src/ingest.cpp``), the part the reference delegates to the JVM and its
+serdes (``CEPProcessor.java:154-163``, ``demo/StockEventSerDe.java:50-89``).
+
+The shared library is built lazily with ``g++`` on first use and cached
+under ``~/.cache/kafkastreams_cep_tpu`` keyed by source hash; loading is via
+``ctypes`` (no pybind11 in this environment).  Every entry point has a pure
+NumPy fallback with identical semantics — ``native.available()`` says which
+is active, and ``CEP_NO_NATIVE=1`` forces the fallback (used by the
+differential tests in ``tests/test_native.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("native")
+
+_SRC = Path(__file__).parent / "src" / "ingest.cpp"
+_ABI_VERSION = 1
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    d = Path(base) / "kafkastreams_cep_tpu"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _build() -> Optional[Path]:
+    try:
+        src = _SRC.read_bytes()
+    except OSError as e:
+        # e.g. a wheel built without the .cpp in package data.
+        logger.warning("native source unavailable (%s); using NumPy fallbacks", e)
+        return None
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"libcepingest-{tag}.so"
+    if out.exists():
+        return out
+    # Build in the cache dir itself so the atomic-publish rename below never
+    # crosses filesystems (tmpfs /tmp vs on-disk home would raise EXDEV).
+    with tempfile.TemporaryDirectory(dir=_cache_dir()) as tmp:
+        tmp_out = Path(tmp) / out.name
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+            str(_SRC), "-o", str(tmp_out),
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+            # Atomic publish so concurrent builders race benignly.
+            os.replace(tmp_out, out)
+        except OSError as e:
+            logger.warning(
+                "native build failed (%s); using NumPy fallbacks", e
+            )
+            return None
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+            detail = getattr(e, "stderr", b"")
+            logger.warning(
+                "native build failed (%s); using NumPy fallbacks: %s",
+                type(e).__name__,
+                detail.decode() if isinstance(detail, bytes) else detail,
+            )
+            return None
+    logger.info("built native ingest library: %s", out)
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("CEP_NO_NATIVE"):
+        logger.info("CEP_NO_NATIVE set; using NumPy fallbacks")
+        return None
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError as e:
+        logger.warning("native load failed (%s); using NumPy fallbacks", e)
+        return None
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32, i64 = ctypes.c_int32, ctypes.c_int64
+
+    lib.cep_native_abi_version.restype = i32
+    if lib.cep_native_abi_version() != _ABI_VERSION:
+        logger.warning("native ABI mismatch; using NumPy fallbacks")
+        return None
+
+    lib.cep_queue_positions.restype = i32
+    lib.cep_queue_positions.argtypes = [i32p, u8p, i64, i32, i32p, i32p]
+    for name, vp in (
+        ("cep_pack_i32", i32p),
+        ("cep_pack_f32", f32p),
+        ("cep_pack_i64", i64p),
+    ):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [vp, vp, i32p, i32p, u8p, i64, i64]
+    lib.cep_pack_valid.restype = None
+    lib.cep_pack_valid.argtypes = [u8p, i32p, i32p, u8p, i64, i64]
+    lib.cep_parse_json_lines.restype = i64
+    lib.cep_parse_json_lines.argtypes = [
+        ctypes.c_char_p, i64, ctypes.c_char_p, i32, ctypes.c_char_p,
+        f64p, ctypes.c_char_p, i64, u8p, i64, i64p,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the C++ library is loaded (False = NumPy fallbacks)."""
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# Lane-queue positions
+
+
+def queue_positions(
+    lanes: np.ndarray, keep: np.ndarray, num_lanes: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Per-record position within its lane queue, queue lengths, and max
+    queue length.  ``lanes[i]`` is record ``i``'s lane; ``keep[i]`` masks
+    dropped records (position -1)."""
+    lanes = np.ascontiguousarray(lanes, dtype=np.int32)
+    keep = np.ascontiguousarray(keep, dtype=np.uint8)
+    n = lanes.shape[0]
+    pos = np.empty(n, dtype=np.int32)
+    qlen = np.zeros(num_lanes, dtype=np.int32)
+    lib = _load()
+    if lib is not None:
+        max_len = lib.cep_queue_positions(
+            _ptr(lanes, ctypes.c_int32), _ptr(keep, ctypes.c_uint8),
+            n, num_lanes, _ptr(pos, ctypes.c_int32),
+            _ptr(qlen, ctypes.c_int32),
+        )
+        return pos, qlen, int(max_len)
+    # NumPy fallback: position = rank of the record among kept records of
+    # its lane, in arrival order.
+    pos.fill(-1)
+    kept = keep.astype(bool)
+    idx = np.nonzero(kept)[0]
+    if idx.size:
+        kl = lanes[idx]
+        order = np.argsort(kl, kind="stable")
+        sor = kl[order]
+        starts = np.r_[0, np.nonzero(np.diff(sor))[0] + 1]
+        ranks = np.arange(sor.size) - np.repeat(starts, np.diff(np.r_[starts, sor.size]))
+        pos[idx[order]] = ranks.astype(np.int32)
+        counts = np.bincount(kl, minlength=num_lanes)
+        qlen[: counts.size] = counts.astype(np.int32)
+    return pos, qlen, int(qlen.max(initial=0))
+
+
+# ---------------------------------------------------------------------------
+# Columnar scatter
+
+
+def pack_column(
+    dst: np.ndarray,
+    src: np.ndarray,
+    lanes: np.ndarray,
+    pos: np.ndarray,
+    keep: np.ndarray,
+) -> None:
+    """``dst[lanes[i], pos[i]] = src[i]`` for every kept record.
+
+    ``dst`` must be C-contiguous ``[K, T]``; dtype must be int32, float32,
+    or int64 (the runtime's column types)."""
+    lanes = np.ascontiguousarray(lanes, dtype=np.int32)
+    pos = np.ascontiguousarray(pos, dtype=np.int32)
+    keep_u8 = np.ascontiguousarray(keep, dtype=np.uint8)
+    src = np.ascontiguousarray(src, dtype=dst.dtype)
+    assert dst.flags.c_contiguous
+    lib = _load()
+    if lib is not None:
+        n, T = lanes.shape[0], dst.shape[1]
+        if dst.dtype == np.int32:
+            lib.cep_pack_i32(
+                _ptr(dst, ctypes.c_int32), _ptr(src, ctypes.c_int32),
+                _ptr(lanes, ctypes.c_int32), _ptr(pos, ctypes.c_int32),
+                _ptr(keep_u8, ctypes.c_uint8), n, T,
+            )
+        elif dst.dtype == np.float32:
+            lib.cep_pack_f32(
+                _ptr(dst, ctypes.c_float), _ptr(src, ctypes.c_float),
+                _ptr(lanes, ctypes.c_int32), _ptr(pos, ctypes.c_int32),
+                _ptr(keep_u8, ctypes.c_uint8), n, T,
+            )
+        elif dst.dtype == np.int64:
+            lib.cep_pack_i64(
+                _ptr(dst, ctypes.c_int64), _ptr(src, ctypes.c_int64),
+                _ptr(lanes, ctypes.c_int32), _ptr(pos, ctypes.c_int32),
+                _ptr(keep_u8, ctypes.c_uint8), n, T,
+            )
+        else:  # pragma: no cover - guarded by runtime column types
+            raise TypeError(f"unsupported pack dtype {dst.dtype}")
+        return
+    m = keep.astype(bool)
+    dst[lanes[m], pos[m]] = src[m]
+
+
+def pack_valid(
+    dst: np.ndarray, lanes: np.ndarray, pos: np.ndarray, keep: np.ndarray
+) -> None:
+    """Set ``dst[lanes[i], pos[i]] = True`` for every kept record (``dst``
+    is the boolean validity grid)."""
+    lanes = np.ascontiguousarray(lanes, dtype=np.int32)
+    pos = np.ascontiguousarray(pos, dtype=np.int32)
+    keep_u8 = np.ascontiguousarray(keep, dtype=np.uint8)
+    lib = _load()
+    if lib is not None and dst.dtype == np.bool_ and dst.flags.c_contiguous:
+        lib.cep_pack_valid(
+            _ptr(dst, ctypes.c_uint8), _ptr(lanes, ctypes.c_int32),
+            _ptr(pos, ctypes.c_int32), _ptr(keep_u8, ctypes.c_uint8),
+            lanes.shape[0], dst.shape[1],
+        )
+        return
+    m = keep.astype(bool)
+    dst[lanes[m], pos[m]] = True
+
+
+# ---------------------------------------------------------------------------
+# JSON-lines parsing
+
+
+def parse_json_lines(
+    text: bytes,
+    fields: Sequence[str],
+    key_field: str = "",
+    key_width: int = 32,
+) -> Tuple[np.ndarray, List[Optional[str]], np.ndarray]:
+    """Parse newline-separated flat JSON objects into columns.
+
+    Returns ``(values[n, F] float64, keys[n], ok[n] bool)`` where ``keys``
+    holds the ``key_field`` string of each line (None when absent, empty, or
+    when the line failed the fast parse).  Lines with ``ok=False`` should be
+    re-parsed by the caller with a full JSON parser — the fast path rejects
+    (rather than interprets) anything outside its fragment: nested
+    containers, escapes, booleans/null in numeric fields, keys longer than
+    ``key_width``.  Lines are ``\n``-separated (no bare-``\r`` splitting).
+    Both paths implement this contract identically.
+    """
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    n_lines = text.count(b"\n") + (0 if text.endswith(b"\n") or not text else 1)
+    F = len(fields)
+    values = np.full((max(n_lines, 1), F), np.nan, dtype=np.float64)
+    ok = np.zeros(max(n_lines, 1), dtype=np.uint8)
+    keys_buf = np.zeros((max(n_lines, 1), key_width), dtype=np.uint8)
+
+    lib = _load()
+    if lib is not None and n_lines:
+        names_blob = b"".join(f.encode() + b"\0" for f in fields)
+        n_bad = ctypes.c_int64(0)
+        consumed = lib.cep_parse_json_lines(
+            text, len(text), names_blob, F, key_field.encode(),
+            _ptr(values, ctypes.c_double),
+            keys_buf.ctypes.data_as(ctypes.c_char_p), key_width,
+            _ptr(ok, ctypes.c_uint8), n_lines, ctypes.byref(n_bad),
+        )
+        if consumed >= 0:
+            keys: List[Optional[str]] = []
+            for i in range(n_lines):
+                if ok[i] and key_field:
+                    raw = bytes(keys_buf[i]).rstrip(b"\0")
+                    keys.append(raw.decode("utf-8", "replace") or None)
+                else:
+                    keys.append(None)
+            return values[:n_lines], keys, ok[:n_lines].astype(bool)
+
+    # Pure-Python fallback — same accept/reject contract as the C++ path.
+    import json
+
+    keys = []
+    lines = text.decode("utf-8").split("\n")
+    if lines and lines[-1] == "" and text.endswith(b"\n"):
+        lines.pop()
+    lines = lines[: values.shape[0]]
+    for i, line in enumerate(lines):
+        row = None
+        key: Optional[str] = None
+        # The native path fails any string containing a backslash (no
+        # escape handling); match that before handing to the full parser.
+        if "\\" in line:
+            keys.append(None)
+            continue
+        try:
+            obj = json.loads(line)
+            if (
+                isinstance(obj, dict)
+                and not any(
+                    isinstance(v, (bool, dict, list)) or v is None
+                    for v in obj.values()
+                )
+                and all(isinstance(obj.get(f), (int, float)) for f in fields)
+            ):
+                row = [float(obj[f]) for f in fields]
+                if key_field:
+                    raw = obj.get(key_field)
+                    if isinstance(raw, str):
+                        if len(raw.encode("utf-8")) > key_width:
+                            row = None  # native: key too wide fails the line
+                        else:
+                            key = raw or None
+        except (ValueError, KeyError, TypeError):
+            row = None
+        if row is None:
+            keys.append(None)
+            continue
+        values[i] = row
+        ok[i] = 1
+        keys.append(key)
+    n = len(lines)
+    return values[:n], keys, ok[:n].astype(bool)

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kafkastreams_cep_tpu import native
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EventBatch
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
@@ -230,14 +231,11 @@ class CEPProcessor:
                 self._key_of[lane] = key
                 logger.info("assigned key %r to lane %d", key, lane)
 
-        # Group into per-lane queues, remembering each record's arrival rank.
-        queues: List[List[int]] = [[] for _ in range(K)]
-        events_by_rank: List[Optional[Event]] = []
+        # Host-event bookkeeping (the decode mirror), one pass.
         dropped = 0
         for rank, rec in enumerate(records):
             off = offsets[rank]
             if off is None:
-                events_by_rank.append(None)
                 dropped += 1
                 continue
             lane = lanes[rank]
@@ -246,15 +244,37 @@ class CEPProcessor:
                 rec.key, rec.value, int(rec.timestamp), self.topic, lane, off
             )
             self._events[lane][off] = event
-            events_by_rank.append(event)
-            queues[lane].append(rank)
         self.metrics.duplicates_dropped += dropped
         if dropped:
             logger.info("dropped %d replayed records (high-water mark)", dropped)
         if all(off is None for off in offsets):
             return []
 
-        T = _bucket(max(len(q) for q in queues))
+        # Lane-queue positions + columnar [K, T] packing via the native
+        # ingest kernels (NumPy fallbacks inside, ``native/``).
+        n = len(records)
+        lanes_arr = np.asarray(lanes, dtype=np.int32)
+        keep = np.fromiter(
+            (off is not None for off in offsets), dtype=np.uint8, count=n
+        )
+        pos, _qlen, max_len = native.queue_positions(lanes_arr, keep, K)
+        T = _bucket(max_len)
+
+        key_col = np.fromiter(
+            (
+                self._key_code(rec.key, lanes[rank])
+                for rank, rec in enumerate(records)
+            ),
+            dtype=np.int32,
+            count=n,
+        )
+        ts_col = np.asarray(rel_ts, dtype=np.int32)
+        off_col = np.fromiter(
+            (off if off is not None else 0 for off in offsets),
+            dtype=np.int32,
+            count=n,
+        )
+        rank_col = np.arange(n, dtype=np.int64)
 
         # Pad to [K, T]; padding slots carry valid=False and leave lane
         # state untouched (engine contract, matcher.py step()).
@@ -263,17 +283,15 @@ class CEPProcessor:
         off = np.zeros((K, T), dtype=np.int32)
         valid = np.zeros((K, T), dtype=bool)
         rank_of = np.full((K, T), -1, dtype=np.int64)
+        native.pack_column(key_arr, key_col, lanes_arr, pos, keep)
+        native.pack_column(ts, ts_col, lanes_arr, pos, keep)
+        native.pack_column(off, off_col, lanes_arr, pos, keep)
+        native.pack_column(rank_of, rank_col, lanes_arr, pos, keep)
+        native.pack_valid(valid, lanes_arr, pos, keep)
         val_leaves = [np.zeros((K, T), dtype=dt) for dt in dtypes]
-        for k, q in enumerate(queues):
-            for t, rank in enumerate(q):
-                ev = events_by_rank[rank]
-                key_arr[k, t] = self._key_code(ev.key, k)
-                ts[k, t] = rel_ts[rank]
-                off[k, t] = ev.offset
-                valid[k, t] = True
-                rank_of[k, t] = rank
-                for i, leaf in enumerate(batch_leaves[rank]):
-                    val_leaves[i][k, t] = leaf
+        for i, dt in enumerate(dtypes):
+            col = np.asarray([leaves[i] for leaves in batch_leaves], dtype=dt)
+            native.pack_column(val_leaves[i], col, lanes_arr, pos, keep)
 
         events = EventBatch(
             key=jnp.asarray(key_arr),

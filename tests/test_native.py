@@ -1,0 +1,171 @@
+"""Native ingest kernels: C++ vs NumPy-fallback differential tests.
+
+Every public entry point of ``kafkastreams_cep_tpu.native`` must produce
+identical results with the C++ library and with the NumPy fallbacks
+(``CEP_NO_NATIVE=1``); these tests run both paths in-process by reaching
+past the module's load cache.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import native
+
+
+def _both_paths():
+    """Yield (label, use_native) for the paths available here."""
+    yield "numpy", False
+    if native.available():
+        yield "native", True
+
+
+def _with_path(use_native, fn):
+    """Run ``fn`` with the native library forced on/off."""
+    saved = native._lib
+    try:
+        if not use_native:
+            native._lib = None
+        return fn()
+    finally:
+        native._lib = saved
+
+
+def test_native_library_builds():
+    # The environment has g++; the library must build and load.  If this
+    # fails, every runtime user silently falls back to NumPy — worth a loud
+    # signal rather than a skip.
+    assert native.available(), "C++ ingest library failed to build/load"
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_queue_positions(label, use_native):
+    lanes = np.array([0, 1, 0, 2, 1, 0, 2, 2], dtype=np.int32)
+    keep = np.array([1, 1, 1, 0, 1, 1, 1, 1], dtype=np.uint8)
+    pos, qlen, max_len = _with_path(
+        use_native, lambda: native.queue_positions(lanes, keep, 4)
+    )
+    assert pos.tolist() == [0, 0, 1, -1, 1, 2, 0, 1]
+    assert qlen.tolist() == [3, 2, 2, 0]
+    assert max_len == 3
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_queue_positions_empty_and_all_dropped(label, use_native):
+    lanes = np.array([0, 1], dtype=np.int32)
+    keep = np.zeros(2, dtype=np.uint8)
+    pos, qlen, max_len = _with_path(
+        use_native, lambda: native.queue_positions(lanes, keep, 2)
+    )
+    assert pos.tolist() == [-1, -1]
+    assert qlen.tolist() == [0, 0]
+    assert max_len == 0
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+@pytest.mark.parametrize("dtype", [np.int32, np.float32, np.int64])
+def test_pack_column(label, use_native, dtype):
+    rng = np.random.default_rng(3)
+    n, K = 64, 8
+    lanes = rng.integers(0, K, size=n).astype(np.int32)
+    keep = (rng.random(n) < 0.8).astype(np.uint8)
+    pos, _, max_len = native.queue_positions(lanes, keep, K)
+    T = max(max_len, 1)
+    src = rng.integers(0, 1000, size=n).astype(dtype)
+
+    dst = np.zeros((K, T), dtype=dtype)
+    _with_path(
+        use_native, lambda: native.pack_column(dst, src, lanes, pos, keep)
+    )
+    expect = np.zeros((K, T), dtype=dtype)
+    m = keep.astype(bool)
+    expect[lanes[m], pos[m]] = src[m]
+    np.testing.assert_array_equal(dst, expect)
+
+    valid = np.zeros((K, T), dtype=bool)
+    _with_path(
+        use_native, lambda: native.pack_valid(valid, lanes, pos, keep)
+    )
+    evalid = np.zeros((K, T), dtype=bool)
+    evalid[lanes[m], pos[m]] = True
+    np.testing.assert_array_equal(valid, evalid)
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_parse_json_lines(label, use_native):
+    lines = [
+        {"name": "e1", "price": 100, "volume": 1010},
+        {"name": "e2", "price": 120.5, "volume": 990},
+        {"name": "e3", "price": -3, "volume": 1.5e3},
+    ]
+    text = "\n".join(json.dumps(o) for o in lines).encode()
+    values, keys, ok = _with_path(
+        use_native,
+        lambda: native.parse_json_lines(text, ["price", "volume"], "name"),
+    )
+    assert ok.all()
+    assert keys == ["e1", "e2", "e3"]
+    np.testing.assert_allclose(
+        values, [[100, 1010], [120.5, 990], [-3, 1500]]
+    )
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_parse_json_lines_bad_lines_are_flagged(label, use_native):
+    text = (
+        b'{"price":1,"volume":2}\n'
+        b"not json at all\n"
+        b'{"price":3}\n'  # missing volume
+        b'{"price":4,"volume":5}'
+    )
+    values, keys, ok = _with_path(
+        use_native,
+        lambda: native.parse_json_lines(text, ["price", "volume"]),
+    )
+    assert ok.tolist() == [True, False, False, True]
+    np.testing.assert_allclose(values[0], [1, 2])
+    np.testing.assert_allclose(values[3], [4, 5])
+
+
+def test_parse_json_lines_whitespace_and_spacing():
+    # json.dumps default spacing (", " separators) must parse too.
+    text = b'  {"price": 7 , "volume": 8}  '
+    values, keys, ok = native.parse_json_lines(text, ["price", "volume"])
+    assert ok.tolist() == [True]
+    np.testing.assert_allclose(values[0], [7, 8])
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_parse_json_lines_reject_contract(label, use_native):
+    """Both paths must reject exactly the same out-of-fragment lines."""
+    cases = [
+        (b'{"name":"' + b"x" * 33 + b'","price":1,"volume":2}', False),  # key > 32
+        (b'{"name":"e\\t1","price":1,"volume":2}', False),  # escape
+        (b'{"price":true,"volume":2}', False),  # bool value
+        (b'{"price":null,"volume":2}', False),  # null value
+        (b'{"price":1,"volume":2,"extra":[1]}', False),  # nested array
+        (b'{"price":"12","volume":2}', False),  # string-typed numeric field
+        (b'{"price":inf,"volume":2}', False),  # not a JSON number
+        (b'{"price":0x1A,"volume":2}', False),  # hex is not JSON
+        (b'{"price":-1.5e2,"volume":2}', True),  # full JSON number grammar
+        (b'{"price":1,"volume":2,"note":"ok"}', True),  # extra string field
+    ]
+    text = b"\n".join(c for c, _ in cases)
+    values, keys, ok = _with_path(
+        use_native,
+        lambda: native.parse_json_lines(text, ["price", "volume"], "name"),
+    )
+    assert ok.tolist() == [want for _, want in cases]
+    np.testing.assert_allclose(values[-2], [-150.0, 2.0])
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_parse_json_lines_empty_key_is_none(label, use_native):
+    text = b'{"name":"","price":1,"volume":2}'
+    values, keys, ok = _with_path(
+        use_native,
+        lambda: native.parse_json_lines(text, ["price", "volume"], "name"),
+    )
+    assert ok.tolist() == [True]
+    assert keys == [None]
