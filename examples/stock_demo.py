@@ -155,12 +155,15 @@ def run_stdin():
             print(format_match(seq, name_of), flush=True)
         chunk.clear()
 
+    # Interactive console producers need per-line matches; piped input
+    # micro-batches for throughput.
+    batch_size = 1 if sys.stdin.isatty() else 64
     for raw in sys.stdin:
         raw = raw.strip()
         if not raw:
             continue
         chunk.append(raw)
-        if len(chunk) >= 64:
+        if len(chunk) >= batch_size:
             flush_chunk()
     flush_chunk()
 

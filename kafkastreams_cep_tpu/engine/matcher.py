@@ -74,6 +74,7 @@ from kafkastreams_cep_tpu.compiler.tables import (
 )
 from kafkastreams_cep_tpu.ops import dewey_ops
 from kafkastreams_cep_tpu.ops import slab as slab_mod
+from kafkastreams_cep_tpu.ops.onehot import get_at, put_at
 from kafkastreams_cep_tpu.pattern.pattern import Pattern
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 
@@ -117,6 +118,11 @@ class EngineConfig:
     dewey_depth: int = 12  # D — fixed Dewey width (overflow counted)
     max_walk: int = 16  # W — buffer walk bound = max match length
     enforce_windows: bool = False  # deviation: functional within() pruning
+    # Apply slab ops one run at a time (the reference's literal op order)
+    # instead of the batched per-step passes.  The batched path reproduces
+    # the same per-entry op order (see ops/slab.py) and is ~2 orders of
+    # magnitude faster on TPU; this switch exists for differential testing.
+    sequential_slab: bool = False
 
 
 class EventBatch(NamedTuple):
@@ -165,6 +171,24 @@ class StepOutput(NamedTuple):
 
 def _as_bool(x) -> jnp.ndarray:
     return jnp.asarray(x, dtype=bool).reshape(())
+
+
+# The batched slab walks extract pointer rows with f32 matmuls (ops/slab.py
+# ``_pack_ptrs``), so event offsets must stay exactly representable in
+# float32.  Host entry points enforce this; the runtime's per-lane offsets
+# are log positions, so the bound is 16.7M events per lane.
+OFFSET_LIMIT = 1 << 24
+
+
+def check_offset(offset: int) -> int:
+    if offset >= OFFSET_LIMIT:
+        raise ValueError(
+            f"event offset {offset} >= 2^24; the engine's f32 pointer packing "
+            "requires per-lane offsets below 16,777,216 — rebase source "
+            "offsets to per-lane log positions (the runtime's auto-assignment "
+            "does this) before feeding the engine"
+        )
+    return int(offset)
 
 
 # Single source of truth for the engine's overflow/drop diagnostics; every
@@ -263,9 +287,16 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         vals = [_as_bool(p(key, value, ts, states)) for p in predicates]
         return jnp.stack(vals)
 
+    # All traced-index reads below go through one-hot selects (ops/onehot)
+    # instead of gathers/scatters so the whole chain fuses on TPU — see the
+    # implementation note in ops/slab.py.
+    def tbl(table, idx):
+        """``table[idx]`` for a static per-stage table and traced index."""
+        return get_at(table, idx)
+
     def pv(preds, pid):
         """Predicate value by id; ``-1`` (absent edge) is False."""
-        return jnp.where(pid >= 0, preds[jnp.maximum(pid, 0)], False)
+        return jnp.where(pid >= 0, get_at(preds, jnp.maximum(pid, 0)), False)
 
     def chain_one(
         alive, id_pos, eval_pos, ver, vlen, event_off, start_ts0, branching, agg,
@@ -278,11 +309,11 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         idc = jnp.maximum(id_pos, 0)
         # getFirstPatternTimestamp (NFA.java:347-349): BEGIN-typed runs reset
         # the window start to the current event's timestamp.
-        id_type_begin = seed | (types[idc] == TYPE_BEGIN)
+        id_type_begin = seed | (tbl(types, idc) == TYPE_BEGIN)
         start = jnp.where(id_type_begin, ts, start_ts0)
 
         if cfg.enforce_windows:
-            w = window_ms[eval_pos]
+            w = tbl(window_ms, eval_pos)
             out_w = (~id_type_begin) & (w != -1) & (ts - start_ts0 > w)
         else:
             # Faithful: epsilon wrappers carry windowMs == -1
@@ -294,7 +325,7 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         # stage off a non-branching run appends ".0".  A branching run never
         # appends (its flag survives the whole chain because setVersion — the
         # only thing that clears it — is itself gated on not-branching).
-        cross0 = ident[eval_pos] != idc
+        cross0 = tbl(ident, eval_pos) != idc
         do_add0 = active & ~seed & cross0 & ~branching
         _, vlen_a, ovf0 = dewey_ops.add_stage(ver, vlen)
         vl = jnp.where(do_add0, vlen_a, vlen)
@@ -322,12 +353,12 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
 
         for _h in range(H):
             cs = jnp.maximum(cur, 0)
-            cop = consume_op[cs]
-            cp = pv(preds, consume_pred[cs])
+            cop = tbl(consume_op, cs)
+            cp = pv(preds, tbl(consume_pred, cs))
             take_m = active & (cop == OP_TAKE) & cp
             begin_m = active & (cop == OP_BEGIN) & cp
-            ig_m = active & pv(preds, ignore_pred[cs])
-            pr_m = active & pv(preds, proceed_pred[cs])
+            ig_m = active & pv(preds, tbl(ignore_pred, cs))
+            pr_m = active & pv(preds, tbl(proceed_pred, cs))
             # The 4-pair nondeterministic branching rule (NFA.java:280-289).
             branch_m = (pr_m & take_m) | (ig_m & take_m) | (ig_m & begin_m) | (ig_m & pr_m)
             branch_m = branch_m & (prev >= 0)  # unreachable for seeds; guard
@@ -339,8 +370,8 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             sb = begin_m  # advance (NFA.java:210-222), kept even when branching
             si = ig_m & ~branch_m  # unchanged re-add (NFA.java:223-227)
             fire = st | sb | si
-            tgt = consume_target[cs]
-            surv_id = jnp.where(fire, jnp.where(si, id_pos, ident[cs]), surv_id)
+            tgt = tbl(consume_target, cs)
+            surv_id = jnp.where(fire, jnp.where(si, id_pos, tbl(ident, cs)), surv_id)
             surv_eval = jnp.where(
                 fire, jnp.where(st, cs, jnp.where(sb, tgt, eval_pos)), surv_eval
             )
@@ -355,20 +386,20 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             # Consuming put; on a branching TAKE the event is recorded under
             # the bumped version and no successor is emitted (NFA.java:206-208).
             put_en.append(consumed)
-            put_cur.append(ident[cs])
-            put_prev.append(jnp.where(prev >= 0, ident[jnp.maximum(prev, 0)], i32(-1)))
+            put_cur.append(tbl(ident, cs))
+            put_prev.append(jnp.where(prev >= 0, tbl(ident, jnp.maximum(prev, 0)), i32(-1)))
             put_ver.append(jnp.where(take_m & branch_m, dewey_ops.add_run(vv, vl), vv))
             put_vlen.append(vl)
 
             # Branch run (NFA.java:231-246): eps(previous, current), version
             # addRun, pointer event = previous when the frame also ignored.
             br_en.append(branch_m)
-            br_prev.append(ident[jnp.maximum(prev, 0)])
+            br_prev.append(tbl(ident, jnp.maximum(prev, 0)))
             br_ver.append(vv)
             br_vlen.append(vl)
             br_run_ver.append(dewey_ops.add_run(vv, vl))
             br_run_vlen.append(vl)
-            br_id.append(ident[jnp.maximum(prev, 0)])
+            br_id.append(tbl(ident, jnp.maximum(prev, 0)))
             br_eval.append(cs)
             br_event.append(jnp.where(ig_m, event_off, off))
             br_start.append(start)
@@ -376,9 +407,9 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             frame_pos.append(cs)
 
             # PROCEED recursion (NFA.java:182-190).
-            ptgt = proceed_target[cs]
+            ptgt = tbl(proceed_target, cs)
             ptc = jnp.maximum(ptgt, 0)
-            do_add = pr_m & (ident[ptc] != ident[cs]) & ~branching
+            do_add = pr_m & (tbl(ident, ptc) != tbl(ident, cs)) & ~branching
             _, vlen_b, ovf_b = dewey_ops.add_stage(vv, vl)
             vl = jnp.where(do_add, vlen_b, vl)
             ovf = ovf + jnp.where(do_add & ovf_b, 1, 0).astype(i32)
@@ -439,60 +470,115 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         # --- Shared-buffer mutations, in the reference's exact op order:
         # per run (queue order): consuming puts frame-by-frame, branch walks
         # deepest-first (they run on recursion unwind), then dead-run path
-        # removal (NFA.java:102-103,117-123).
+        # removal (NFA.java:102-103,117-123).  The batched path applies the
+        # same ops phase-by-phase with identical per-entry ordering
+        # (ops/slab.py batched kernels); the sequential path below executes
+        # them literally one run at a time.
+        final_en = rec.surv_alive & rec.surv_final & valid
+
         def run_body(r, slab):
-            prev_off = state.event_off[r]
+            # Row extraction by one-hot (r is a traced loop index); the ``h``
+            # indexing below is static.
+            prev_off = get_at(state.event_off, r)
+            put_en = get_at(rec.put_en, r)
+            put_cur = get_at(rec.put_cur, r)
+            put_prev = get_at(rec.put_prev, r)
+            put_ver = get_at(rec.put_ver, r)
+            put_vlen = get_at(rec.put_vlen, r)
             for h in range(H):
-                en = rec.put_en[r, h]
-                first = en & (rec.put_prev[r, h] < 0)
-                chained = en & (rec.put_prev[r, h] >= 0)
+                en = put_en[h]
+                first = en & (put_prev[h] < 0)
+                chained = en & (put_prev[h] >= 0)
                 slab = slab_mod.put_first(
-                    slab, rec.put_cur[r, h], off,
-                    rec.put_ver[r, h], rec.put_vlen[r, h], enable=first,
+                    slab, put_cur[h], off,
+                    put_ver[h], put_vlen[h], enable=first,
                 )
                 slab = slab_mod.put(
-                    slab, rec.put_cur[r, h], off, rec.put_prev[r, h], prev_off,
-                    rec.put_ver[r, h], rec.put_vlen[r, h], enable=chained,
+                    slab, put_cur[h], off, put_prev[h], prev_off,
+                    put_ver[h], put_vlen[h], enable=chained,
                 )
+            br_en = get_at(rec.br_en, r)
+            br_prev = get_at(rec.br_prev, r)
+            br_ver = get_at(rec.br_ver, r)
+            br_vlen = get_at(rec.br_vlen, r)
             for h in range(H - 1, -1, -1):
                 slab = slab_mod.branch(
-                    slab, rec.br_prev[r, h], prev_off,
-                    rec.br_ver[r, h], rec.br_vlen[r, h], W,
-                    enable=rec.br_en[r, h],
+                    slab, br_prev[h], prev_off,
+                    br_ver[h], br_vlen[h], W,
+                    enable=br_en[h],
                 )
-            dead_en = rec.dead[r] & (state.event_off[r] >= 0)
+            dead_en = get_at(rec.dead, r) & (prev_off >= 0)
             slab, _, _, _ = slab_mod.peek(
-                slab, jnp.maximum(state.id_pos[r], 0), state.event_off[r],
-                state.ver[r], state.vlen[r], W, remove=True, enable=dead_en,
+                slab, jnp.maximum(get_at(state.id_pos, r), 0), prev_off,
+                get_at(state.ver, r), get_at(state.vlen, r), W,
+                remove=True, enable=dead_en,
             )
             return slab
 
-        slab = jax.lax.fori_loop(0, R, run_body, state.slab)
-
-        # --- Match construction for final states, after all runs
-        # (NFA.java:111-115), in queue order.
-        final_en = rec.surv_alive & rec.surv_final & valid
-
         def fin_body(r, carry):
             slab, out_stage, out_off, out_count = carry
+            fe = get_at(final_en, r)
             slab, st_row, off_row, cnt = slab_mod.peek(
-                slab, rec.surv_id[r], off, rec.surv_ver[r], rec.surv_vlen[r],
-                W, remove=True, enable=final_en[r],
+                slab, get_at(rec.surv_id, r), off, get_at(rec.surv_ver, r),
+                get_at(rec.surv_vlen, r), W, remove=True, enable=fe,
             )
-            out_stage = out_stage.at[r].set(jnp.where(final_en[r], st_row, out_stage[r]))
-            out_off = out_off.at[r].set(jnp.where(final_en[r], off_row, out_off[r]))
-            out_count = out_count.at[r].set(jnp.where(final_en[r], cnt, 0))
+            out_stage = put_at(out_stage, r, st_row[None, :], enable=fe)
+            out_off = put_at(out_off, r, off_row[None, :], enable=fe)
+            out_count = put_at(out_count, r, cnt, enable=fe)
             return slab, out_stage, out_off, out_count
 
-        slab, out_stage, out_off, out_count = jax.lax.fori_loop(
-            0, R, fin_body,
-            (
-                slab,
-                jnp.full((R, W), -1, i32),
-                jnp.full((R, W), -1, i32),
-                jnp.zeros((R,), i32),
-            ),
-        )
+        if cfg.sequential_slab:
+            slab = jax.lax.fori_loop(0, R, run_body, state.slab)
+            # Match construction for final states, after all runs
+            # (NFA.java:111-115), in queue order.
+            slab, out_stage, out_off, out_count = jax.lax.fori_loop(
+                0, R, fin_body,
+                (
+                    slab,
+                    jnp.full((R, W), -1, i32),
+                    jnp.full((R, W), -1, i32),
+                    jnp.zeros((R,), i32),
+                ),
+            )
+        else:
+            RH = R * H
+            # Consuming puts, flattened run-major / frame-ascending — the
+            # reference's op order.  A put's predecessor offset is its run's
+            # pointer event, identical across frames.
+            prev_off_rep = jnp.repeat(state.event_off, H)
+            ops = slab_mod.PutOps(
+                en=rec.put_en.reshape(RH),
+                first=rec.put_prev.reshape(RH) < 0,
+                cur_stage=rec.put_cur.reshape(RH),
+                prev_stage=rec.put_prev.reshape(RH),
+                prev_off=prev_off_rep,
+                ver=rec.put_ver.reshape(RH, D),
+                vlen=rec.put_vlen.reshape(RH),
+            )
+            slab = slab_mod.puts_batched(state.slab, ops, off)
+
+            # Branch walks, deepest-first within each run (unwind order).
+            def rev(f):
+                return f[:, ::-1].reshape((RH,) + f.shape[2:])
+
+            slab = slab_mod.branch_batched(
+                slab, rev(rec.br_en), rev(rec.br_prev), prev_off_rep,
+                rev(rec.br_ver), rev(rec.br_vlen), W,
+            )
+
+            # Dead-run path removal, queue order (NFA.java:102-103,117-123).
+            dead_en = rec.dead & (state.event_off >= 0)
+            slab, _, _, _ = slab_mod.peek_batched(
+                slab, dead_en, jnp.maximum(state.id_pos, 0),
+                state.event_off, state.ver, state.vlen, W, remove=True,
+            )
+
+            # Match construction for final states (NFA.java:111-115).
+            slab, out_stage, out_off, out_count = slab_mod.peek_batched(
+                slab, final_en, rec.surv_id,
+                jnp.broadcast_to(off, (R,)), rec.surv_ver, rec.surv_vlen,
+                W, remove=True,
+            )
 
         # --- Next queue: per run [survivor, branches deepest-first, re-seed],
         # flattened in queue order, compacted into R slots (overflow counted).
@@ -543,15 +629,22 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         flat_alive = c_alive.reshape(RS)
         idx = jnp.cumsum(flat_alive.astype(i32)) - 1
         keep = flat_alive & (idx < R)
-        dest = jnp.where(keep, idx, R)
         dropped = jnp.sum((flat_alive & (idx >= R)).astype(i32))
+
+        # Scatter-free compaction: each kept candidate's one-hot destination
+        # row, reduced over the candidate axis (at most one source per slot).
+        ohm = keep[:, None] & (idx[:, None] == jnp.arange(R, dtype=i32)[None, :])
 
         def compact(field, fill=0):
             flat = field.reshape((RS,) + field.shape[2:])
-            out = jnp.full((R + 1,) + flat.shape[1:], fill, flat.dtype)
-            return out.at[dest].set(flat)[:R]
+            m = ohm.reshape((RS, R) + (1,) * (flat.ndim - 1))
+            if flat.dtype == jnp.bool_:
+                return jnp.any(m & flat[:, None], axis=0)
+            vals = jnp.sum(jnp.where(m, flat[:, None], 0), axis=0).astype(flat.dtype)
+            got = jnp.any(m, axis=0).reshape((R,) + (1,) * (flat.ndim - 1))
+            return jnp.where(got, vals, jnp.asarray(fill, flat.dtype))
 
-        new_alive = jnp.zeros((R + 1,), bool).at[dest].set(flat_alive)[:R]
+        new_alive = jnp.any(ohm & flat_alive[:, None], axis=0)
         new_state = EngineState(
             alive=new_alive,
             id_pos=compact(c_id, -1),
@@ -677,6 +770,7 @@ class MatcherSession:
     ) -> List[Sequence]:
         if offset is None:
             offset = self._offset
+        check_offset(offset)
         self._offset = max(self._offset, offset + 1)
         event = Event(key, value, timestamp, topic, partition, offset)
         self._events[offset] = event

@@ -278,8 +278,14 @@ def parse_json_lines(
     """
     if isinstance(text, str):
         text = text.encode("utf-8")
-    n_lines = text.count(b"\n") + (0 if text.endswith(b"\n") or not text else 1)
     F = len(fields)
+    if not text:
+        return (
+            np.zeros((0, F), dtype=np.float64),
+            [],
+            np.zeros(0, dtype=bool),
+        )
+    n_lines = text.count(b"\n") + (0 if text.endswith(b"\n") else 1)
     values = np.full((max(n_lines, 1), F), np.nan, dtype=np.float64)
     ok = np.zeros(max(n_lines, 1), dtype=np.uint8)
     keys_buf = np.zeros((max(n_lines, 1), key_width), dtype=np.uint8)
@@ -306,9 +312,20 @@ def parse_json_lines(
 
     # Pure-Python fallback — same accept/reject contract as the C++ path.
     import json
+    import math
+
+    def _tofloat(v):
+        # Match strtod: JSON integer literals beyond float range are ±inf.
+        try:
+            return float(v)
+        except OverflowError:
+            return math.inf if v > 0 else -math.inf
 
     keys = []
-    lines = text.decode("utf-8").split("\n")
+    # errors="replace" mirrors the native path: invalid bytes fail a line's
+    # JSON parse (outside strings) or survive as U+FFFD inside key strings,
+    # never crash.
+    lines = text.decode("utf-8", errors="replace").split("\n")
     if lines and lines[-1] == "" and text.endswith(b"\n"):
         lines.pop()
     lines = lines[: values.shape[0]]
@@ -330,7 +347,7 @@ def parse_json_lines(
                 )
                 and all(isinstance(obj.get(f), (int, float)) for f in fields)
             ):
-                row = [float(obj[f]) for f in fields]
+                row = [_tofloat(obj[f]) for f in fields]
                 if key_field:
                     raw = obj.get(key_field)
                     if isinstance(raw, str):

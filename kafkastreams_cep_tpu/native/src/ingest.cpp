@@ -180,25 +180,39 @@ int64_t cep_parse_json_lines(const char* buf, int64_t len,
           if (key_width > 0 && fname_len == key_len_name &&
               memcmp(fname, key_field, fname_len) == 0) {
             if (vlen > key_width) { ok = false; break; }  // key too wide
+            memset(krow, 0, key_width);  // duplicated field: last one wins
             memcpy(krow, vstart, vlen);
           }
-        } else {  // numeric value (true/false/null fail the charset check)
-          // Constrain to the JSON number grammar before strtod: first char
-          // '-' or digit, token chars in [-+0-9.eE] — rejects inf/nan/hex,
-          // which strtod would otherwise accept but json.loads does not.
-          if (*q != '-' && (*q < '0' || *q > '9')) { ok = false; break; }
+        } else {  // numeric value (true/false/null fail the grammar check)
           char* numend = nullptr;
           double v = strtod(q, &numend);
           if (numend == q || numend > line_end) { ok = false; break; }
-          bool charset_ok = true;
-          for (const char* c = q; c < numend; ++c) {
-            if (!((*c >= '0' && *c <= '9') || *c == '-' || *c == '+' ||
-                  *c == '.' || *c == 'e' || *c == 'E')) {
-              charset_ok = false;
-              break;
-            }
+          // The consumed token must match the exact JSON number grammar —
+          // strtod alone also accepts inf/nan/hex, leading zeros ("01"),
+          // bare trailing dots ("1."), and "1.e3", all of which json.loads
+          // (the fallback) rejects.
+          const char* c = q;
+          if (c < numend && *c == '-') ++c;
+          if (c < numend && *c == '0') {
+            ++c;  // a leading 0 must be the whole integer part
+          } else if (c < numend && *c >= '1' && *c <= '9') {
+            while (c < numend && *c >= '0' && *c <= '9') ++c;
+          } else {
+            ok = false;
+            break;
           }
-          if (!charset_ok) { ok = false; break; }
+          if (c < numend && *c == '.') {
+            ++c;
+            if (c >= numend || *c < '0' || *c > '9') { ok = false; break; }
+            while (c < numend && *c >= '0' && *c <= '9') ++c;
+          }
+          if (c < numend && (*c == 'e' || *c == 'E')) {
+            ++c;
+            if (c < numend && (*c == '+' || *c == '-')) ++c;
+            if (c >= numend || *c < '0' || *c > '9') { ok = false; break; }
+            while (c < numend && *c >= '0' && *c <= '9') ++c;
+          }
+          if (c != numend) { ok = false; break; }
           for (int32_t f = 0; f < num_fields; ++f) {
             if (fname_len == name_lens[f] &&
                 memcmp(fname, names[f], fname_len) == 0) {

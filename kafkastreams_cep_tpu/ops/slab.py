@@ -31,6 +31,14 @@ Semantics preserved exactly (differentially tested against the host buffer):
   (``KVSharedVersionedBuffer.java:147-171``);
 * capacity limits (slab full, pointer list full, walk bound) have no
   reference analog; overflows are counted, never raised.
+
+Implementation note: no traced-index scatters/gathers/dynamic-slices.
+Every indexed read/write goes through one-hot masked selects (``_oh`` /
+``_get_e`` / ``_get_ej``), which XLA fuses into the surrounding
+elementwise work.  On TPU, batched-index scatter/gather ops do not fuse —
+each becomes a standalone kernel whose launch overhead, times the
+thousands of tiny slab ops per step, dominated the engine's early runtime
+by ~50x (and scaled linearly with the vmapped lane count).
 """
 
 from __future__ import annotations
@@ -41,6 +49,11 @@ import jax
 import jax.numpy as jnp
 
 from kafkastreams_cep_tpu.ops import dewey_ops
+from kafkastreams_cep_tpu.ops.onehot import (
+    get_at as _get_e,
+    get_at2 as _get_ej,
+    oh as _oh,
+)
 
 
 class SlabState(NamedTuple):
@@ -92,9 +105,9 @@ def _select_pointer(slab: SlabState, e, qver, qlen):
     """First version-compatible predecessor pointer of entry ``e``
     (``TimedKeyValue.java:83-92``)."""
     mp = slab.pstage.shape[1]
-    valid = jnp.arange(mp, dtype=jnp.int32) < slab.npreds[e]
+    valid = jnp.arange(mp, dtype=jnp.int32) < _get_e(slab.npreds, e)
     compat = jax.vmap(dewey_ops.is_compatible, in_axes=(None, None, 0, 0))(
-        qver, qlen, slab.pver[e], slab.pvlen[e]
+        qver, qlen, _get_e(slab.pver, e), _get_e(slab.pvlen, e)
     )
     hit = compat & valid
     return jnp.argmax(hit), jnp.any(hit)
@@ -102,21 +115,19 @@ def _select_pointer(slab: SlabState, e, qver, qlen):
 
 def _append_pointer(slab: SlabState, e, pstage, poff, ver, vlen, enable):
     """Append a pointer to entry ``e``'s list; drops (counted) when full."""
-    mp = slab.pstage.shape[1]
-    n = slab.npreds[e]
+    E, mp = slab.pstage.shape
+    n = _get_e(slab.npreds, e)
     full = n >= mp
     do = enable & ~full
     slot = jnp.minimum(n, mp - 1)
-
-    def upd(field, value):
-        return field.at[e, slot].set(jnp.where(do, value, field[e, slot]))
+    m2 = (_oh(e, E)[:, None] & _oh(slot, mp)[None, :]) & do
 
     return slab._replace(
-        pstage=upd(slab.pstage, pstage),
-        poff=upd(slab.poff, poff),
-        pver=slab.pver.at[e, slot].set(jnp.where(do, ver, slab.pver[e, slot])),
-        pvlen=upd(slab.pvlen, vlen),
-        npreds=slab.npreds.at[e].add(jnp.where(do, 1, 0)),
+        pstage=jnp.where(m2, pstage, slab.pstage),
+        poff=jnp.where(m2, poff, slab.poff),
+        pver=jnp.where(m2[:, :, None], ver[None, None, :], slab.pver),
+        pvlen=jnp.where(m2, vlen, slab.pvlen),
+        npreds=slab.npreds + jnp.where(_oh(e, E) & do, 1, 0),
         pred_drops=slab.pred_drops + jnp.where(enable & full, 1, 0),
     )
 
@@ -124,23 +135,22 @@ def _append_pointer(slab: SlabState, e, pstage, poff, ver, vlen, enable):
 def _prune_pointer(slab: SlabState, e, j, enable):
     """Remove pointer ``j`` of entry ``e``, shifting later pointers left to
     keep insertion order (``TimedKeyValue.removePredecessor``)."""
-    mp = slab.pstage.shape[1]
+    E, mp = slab.pstage.shape
     idx = jnp.arange(mp, dtype=jnp.int32)
-    src = jnp.where(idx >= j, jnp.minimum(idx + 1, mp - 1), idx)
+    # Shift-by-one as a static roll + mask: slot i >= j takes slot i+1's
+    # value (the last slot keeps its own — matching min(i+1, mp-1)).
+    m2 = (_oh(e, E)[:, None] & (idx[None, :] >= j)) & enable
 
-    def shift(field):
-        return jnp.where(enable, jnp.take(field, src, axis=0), field)
+    def shift(field, m):
+        nxt = jnp.concatenate([field[:, 1:], field[:, -1:]], axis=1)
+        return jnp.where(m, nxt, field)
 
-    pstage_e = shift(slab.pstage[e])
-    poff_e = shift(slab.poff[e])
-    pvlen_e = shift(slab.pvlen[e])
-    pver_e = shift(slab.pver[e])
     return slab._replace(
-        pstage=slab.pstage.at[e].set(pstage_e),
-        poff=slab.poff.at[e].set(poff_e),
-        pvlen=slab.pvlen.at[e].set(pvlen_e),
-        pver=slab.pver.at[e].set(pver_e),
-        npreds=slab.npreds.at[e].add(jnp.where(enable, -1, 0)),
+        pstage=shift(slab.pstage, m2),
+        poff=shift(slab.poff, m2),
+        pvlen=shift(slab.pvlen, m2),
+        pver=shift(slab.pver, m2[:, :, None]),
+        npreds=slab.npreds - jnp.where(_oh(e, E) & enable, 1, 0),
     )
 
 
@@ -153,15 +163,13 @@ def put_first(slab: SlabState, stage, off, ver, vlen, enable=True) -> SlabState:
     free, has_free = _alloc(slab)
     e = jnp.where(found, existing, free)
     ok = enable & (found | has_free)
-
-    def set1(field, value):
-        return field.at[e].set(jnp.where(ok, value, field[e]))
+    m1 = _oh(e, slab.stage.shape[0]) & ok
 
     slab = slab._replace(
-        stage=set1(slab.stage, stage),
-        off=set1(slab.off, off),
-        refs=set1(slab.refs, 1),
-        npreds=set1(slab.npreds, 0),
+        stage=jnp.where(m1, stage, slab.stage),
+        off=jnp.where(m1, off, slab.off),
+        refs=jnp.where(m1, 1, slab.refs),
+        npreds=jnp.where(m1, 0, slab.npreds),
         full_drops=slab.full_drops + jnp.where(enable & ~found & ~has_free, 1, 0),
     )
     return _append_pointer(slab, e, jnp.int32(-1), jnp.int32(-1), ver, vlen, ok)
@@ -183,15 +191,13 @@ def put(slab: SlabState, cur_stage, cur_off, prev_stage, prev_off, ver, vlen, en
     e = jnp.where(found, existing, free)
     create = enable & ~found & has_free
     ok = enable & (found | has_free)
-
-    def init1(field, value):
-        return field.at[e].set(jnp.where(create, value, field[e]))
+    m1 = _oh(e, slab.stage.shape[0]) & create
 
     slab = slab._replace(
-        stage=init1(slab.stage, cur_stage),
-        off=init1(slab.off, cur_off),
-        refs=init1(slab.refs, 1),
-        npreds=init1(slab.npreds, 0),
+        stage=jnp.where(m1, cur_stage, slab.stage),
+        off=jnp.where(m1, cur_off, slab.off),
+        refs=jnp.where(m1, 1, slab.refs),
+        npreds=jnp.where(m1, 0, slab.npreds),
         full_drops=slab.full_drops + jnp.where(enable & ~found & ~has_free, 1, 0),
     )
     return _append_pointer(slab, e, prev_stage, prev_off, ver, vlen, ok)
@@ -206,13 +212,16 @@ def branch(slab: SlabState, stage, off, ver, vlen, max_walk: int, enable=True) -
         e, found = find(slab, stage, off)
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
-        slab = slab._replace(refs=slab.refs.at[e].add(jnp.where(active, 1, 0)))
+        slab = slab._replace(
+            refs=slab.refs + jnp.where(_oh(e, slab.refs.shape[0]) & active, 1, 0)
+        )
         j, sel = _select_pointer(slab, e, qver, qlen)
-        active = active & sel & (slab.pstage[e, j] >= 0)
-        stage = jnp.where(active, slab.pstage[e, j], stage)
-        off = jnp.where(active, slab.poff[e, j], off)
-        qver = jnp.where(active, slab.pver[e, j], qver)
-        qlen = jnp.where(active, slab.pvlen[e, j], qlen)
+        nxt_stage = _get_ej(slab.pstage, e, j)
+        active = active & sel & (nxt_stage >= 0)
+        stage = jnp.where(active, nxt_stage, stage)
+        off = jnp.where(active, _get_ej(slab.poff, e, j), off)
+        qver = jnp.where(active, _get_ej(slab.pver, e, j), qver)
+        qlen = jnp.where(active, _get_ej(slab.pvlen, e, j), qlen)
         return slab, stage, off, qver, qlen, active
 
     init = (
@@ -255,31 +264,35 @@ def peek(
 
     def body(i, carry):
         slab, stage, off, qver, qlen, active, out_stage, out_off, count = carry
+        E = slab.stage.shape[0]
         e, found = find(slab, stage, off)
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
+        m1 = _oh(e, E) & active
 
-        refs_left = jnp.maximum(slab.refs[e] - 1, 0)  # floors at zero
-        slab = slab._replace(
-            refs=slab.refs.at[e].set(jnp.where(active, refs_left, slab.refs[e]))
+        refs_left = jnp.maximum(_get_e(slab.refs, e) - 1, 0)  # floors at zero
+        slab = slab._replace(refs=jnp.where(m1, refs_left, slab.refs))
+        delete = (
+            active & remove & (refs_left == 0) & (_get_e(slab.npreds, e) <= 1)
         )
-        delete = active & remove & (refs_left == 0) & (slab.npreds[e] <= 1)
+        md = _oh(e, E) & delete
         slab = slab._replace(
-            stage=slab.stage.at[e].set(jnp.where(delete, -1, slab.stage[e])),
-            off=slab.off.at[e].set(jnp.where(delete, -1, slab.off[e])),
+            stage=jnp.where(md, -1, slab.stage),
+            off=jnp.where(md, -1, slab.off),
         )
 
-        out_stage = out_stage.at[i].set(jnp.where(active, stage, out_stage[i]))
-        out_off = out_off.at[i].set(jnp.where(active, off, out_off[i]))
+        mi = _oh(i, out_stage.shape[0]) & active
+        out_stage = jnp.where(mi, stage, out_stage)
+        out_off = jnp.where(mi, off, out_off)
         count = count + jnp.where(active, 1, 0)
 
         j, sel = _select_pointer(slab, e, qver, qlen)
         sel = sel & active
         prune = sel & remove & (refs_left == 0)
-        nxt_stage = slab.pstage[e, j]
-        nxt_off = slab.poff[e, j]
-        nxt_ver = slab.pver[e, j]
-        nxt_len = slab.pvlen[e, j]
+        nxt_stage = _get_ej(slab.pstage, e, j)
+        nxt_off = _get_ej(slab.poff, e, j)
+        nxt_ver = _get_ej(slab.pver, e, j)
+        nxt_len = _get_ej(slab.pvlen, e, j)
         slab = _prune_pointer(slab, e, j, prune)
 
         active = sel & (nxt_stage >= 0)
@@ -312,6 +325,487 @@ def peek(
 def live_entries(slab: SlabState) -> jnp.ndarray:
     """Number of occupied slots (host/diagnostic helper)."""
     return jnp.sum(slab.stage >= 0)
+
+
+# ---------------------------------------------------------------------------
+# Batched per-step kernels
+#
+# The sequential entry points above apply ONE op per call; chained under the
+# engine's per-run loop that costs a full pass over the pointer arrays per op
+# (HBM-bound) or a serial kernel chain (launch-bound).  The batched kernels
+# apply ALL of one event-step's ops in a constant number of wide passes:
+#
+# * ``puts_batched``   — the step's consuming puts, in queue/frame order,
+#   grouped by target entry (every consuming put of one step targets the
+#   *current* event, so groups are keyed by stage);
+# * ``branch_batched`` — all branch refcount walks in lockstep.  Increments
+#   commute and pointer selection never reads refcounts, so lockstep is
+#   *exactly* sequential order;
+# * ``peek_batched``   — all removal walks in lockstep with a same-entry
+#   stall protocol: when two walkers meet at one entry in the same hop, the
+#   later (higher run-slot) walker waits, so per-entry mutation order equals
+#   the reference's queue order.  Walks are backward over strictly older
+#   events, so no walker revisits an entry and stalls always clear.
+#
+# Walk-phase row extraction runs as one f32 matmul per hop on the packed
+# pointer tensor (ver ∘ pstage ∘ poff ∘ pvlen) — MXU work; all packed values
+# are small ints (< 2^24), exact in f32.
+# ---------------------------------------------------------------------------
+
+
+class PutOps(NamedTuple):
+    """One step's consuming puts, flattened in reference order (queue order,
+    then frame order within a run)."""
+
+    en: jnp.ndarray  # [P] bool
+    first: jnp.ndarray  # [P] bool — put_first (null-predecessor origin)
+    cur_stage: jnp.ndarray  # [P] int32 — target stage (identity position)
+    prev_stage: jnp.ndarray  # [P] int32 — -1 for first puts
+    prev_off: jnp.ndarray  # [P] int32
+    ver: jnp.ndarray  # [P, D] int32
+    vlen: jnp.ndarray  # [P] int32
+
+
+def puts_batched(slab: SlabState, ops: PutOps, off) -> SlabState:
+    """Apply all of one step's consuming puts in one pass.
+
+    Replicates the sequential semantics op by op: chained puts require an
+    existing predecessor (else counted ``missing``); the *last* ``put_first``
+    of a target group resets the entry and erases the group's earlier
+    appends (``KVSharedVersionedBuffer.java:117-128`` overwrite quirk);
+    surviving appends take consecutive pointer slots in op order.  All
+    targets share the current event offset ``off``, so groups are keyed by
+    ``cur_stage`` alone; predecessors always reference older events, so no
+    op's predecessor lookup can observe another op of the same step.
+    """
+    i32 = jnp.int32
+    E, MP = slab.pstage.shape
+    P = ops.en.shape[0]
+    pidx = jnp.arange(P, dtype=i32)
+    earlier = pidx[None, :] < pidx[:, None]  # [p, p']: p' before p
+    later = pidx[None, :] > pidx[:, None]
+
+    # Chained puts need an existing predecessor entry.
+    prev_hit = (slab.stage[None, :] == ops.prev_stage[:, None]) & (
+        slab.off[None, :] == ops.prev_off[:, None]
+    )
+    prev_found = jnp.any(prev_hit, axis=1)
+    miss = ops.en & ~ops.first & ~prev_found
+    en = ops.en & (ops.first | prev_found)
+
+    # Target grouping by stage (same group == same target entry).
+    same = ops.cur_stage[None, :] == ops.cur_stage[:, None]  # [P, P]
+    cur_hit = (slab.stage[None, :] == ops.cur_stage[:, None]) & (
+        slab.off[None, :] == off
+    )
+    exist0 = jnp.any(cur_hit, axis=1)
+    e0 = jnp.argmax(cur_hit, axis=1)
+
+    # Entry allocation: the first enabled op of a group whose entry does not
+    # exist claims the next free slot (creators ranked in op order).
+    first_of_group = en & ~jnp.any(same & earlier & en[None, :], axis=1)
+    creator = first_of_group & ~exist0
+    crank = jnp.cumsum(creator.astype(i32)) - 1
+    free = slab.stage < 0
+    nfree = jnp.sum(free.astype(i32))
+    free_rank = jnp.cumsum(free.astype(i32)) - 1  # [E]
+    alloc_hit = (
+        free[None, :] & (free_rank[None, :] == crank[:, None]) & creator[:, None]
+    )
+    has_free = creator & (crank < nfree)
+    grp_creator = same & creator[None, :]  # [P, P]
+    alloc_e_all = jnp.argmax(alloc_hit, axis=1)
+    e_created = jnp.sum(jnp.where(grp_creator, alloc_e_all[None, :], 0), axis=1)
+    grp_has_free = jnp.any(grp_creator & has_free[None, :], axis=1)
+    e = jnp.where(exist0, e0, e_created).astype(i32)
+    entry_ok = en & (exist0 | grp_has_free)
+    # Sequential parity: every op that finds neither an existing entry nor a
+    # free slot counts one full drop.
+    full = en & ~exist0 & ~grp_has_free
+
+    # put_first reset: a first-put that lands (entry_ok) resets its entry's
+    # pointer list; the group's ops therefore run in *segments* delimited by
+    # resets.  Every segment's appends really happened sequentially (and can
+    # drop on overflow — counted), but only the final segment's writes
+    # survive the last reset.
+    isfirst_ok = entry_ok & ops.first
+    reset_at_or_before = same & ~later & isfirst_ok[None, :]
+    has_reset = jnp.any(reset_at_or_before, axis=1)
+    seg_head = jnp.max(jnp.where(reset_at_or_before, pidx[None, :], -1), axis=1)
+    seg_eq = same & (seg_head[None, :] == seg_head[:, None])
+
+    npreds0_e = jnp.sum(jnp.where(cur_hit, slab.npreds[None, :], 0), axis=1)
+    base0 = jnp.where(exist0, npreds0_e, 0)
+    base = jnp.where(has_reset, 0, base0)
+
+    # npreds as each op saw it: base of its segment plus earlier successful
+    # appends in the segment (appends saturate at MP — a dropped append
+    # leaves npreds unchanged for its successors).
+    prior = jnp.sum((seg_eq & earlier & entry_ok[None, :]).astype(i32), axis=1)
+    slot = jnp.minimum(base + prior, MP)
+    pred_drop = entry_ok & (slot >= MP)
+
+    # Only final-segment ops persist (no reset after them in the group).
+    last_seg = ~jnp.any(same & later & isfirst_ok[None, :], axis=1)
+    surv = entry_ok & last_seg
+    fit = surv & (slot < MP)
+    grp_has_first = jnp.any(same & isfirst_ok[None, :], axis=1)
+    base_n = jnp.where(grp_has_first | ~exist0, 0, npreds0_e)
+
+    entry_oh = (jnp.arange(E, dtype=i32)[None, :] == e[:, None]) & fit[:, None]
+    slot_oh = jnp.arange(MP, dtype=i32)[None, :] == slot[:, None]
+    m3 = entry_oh[:, :, None] & slot_oh[:, None, :]  # [P, E, MP]
+    hit3 = jnp.any(m3, axis=0)
+
+    pstage_val = jnp.where(ops.first, -1, ops.prev_stage)
+    poff_val = jnp.where(ops.first, -1, ops.prev_off)
+
+    def write(field, val):
+        upd = jnp.sum(jnp.where(m3, val[:, None, None], 0), axis=0)
+        return jnp.where(hit3, upd.astype(field.dtype), field)
+
+    new_pstage = write(slab.pstage, pstage_val)
+    new_poff = write(slab.poff, poff_val)
+    new_pvlen = write(slab.pvlen, ops.vlen)
+    upd_v = jnp.sum(
+        jnp.where(m3[..., None], ops.ver[:, None, None, :], 0), axis=0
+    )
+    new_pver = jnp.where(hit3[..., None], upd_v.astype(slab.pver.dtype), slab.pver)
+
+    # Entry metadata, group-consistent (cnt is the group's fit count).
+    cnt = jnp.sum((same & fit[None, :]).astype(i32), axis=1)
+    npreds_val = jnp.minimum(base_n + cnt, MP)
+    reset_refs = grp_has_first | ~exist0
+    ge = (jnp.arange(E, dtype=i32)[None, :] == e[:, None]) & entry_ok[:, None]
+    anyop = jnp.any(ge, axis=0)
+    npreds_e = jnp.max(jnp.where(ge, npreds_val[:, None], 0), axis=0)
+    setref_e = jnp.any(ge & reset_refs[:, None], axis=0)
+    stage_e = jnp.max(jnp.where(ge, ops.cur_stage[:, None], -1), axis=0)
+
+    return slab._replace(
+        stage=jnp.where(anyop, stage_e.astype(i32), slab.stage),
+        off=jnp.where(anyop, off, slab.off),
+        refs=jnp.where(anyop & setref_e, 1, slab.refs),
+        npreds=jnp.where(anyop, npreds_e.astype(i32), slab.npreds),
+        pstage=new_pstage,
+        poff=new_poff,
+        pvlen=new_pvlen,
+        pver=new_pver,
+        missing=slab.missing + jnp.sum(miss.astype(i32)),
+        full_drops=slab.full_drops + jnp.sum(full.astype(i32)),
+        pred_drops=slab.pred_drops + jnp.sum(pred_drop.astype(i32)),
+    )
+
+
+def _pack_ptrs(slab: SlabState) -> jnp.ndarray:
+    """Pointer arrays packed as one f32 tensor ``[E, MP, D+3]`` so walk-hop
+    row extraction is a single MXU matmul.  Layout: ver, pstage, poff, pvlen.
+    All values are small ints — exact in f32 (offsets are bounded by the
+    engine's documented 2^24-events-per-lane limit)."""
+    return jnp.concatenate(
+        [
+            slab.pver.astype(jnp.float32),
+            slab.pstage[..., None].astype(jnp.float32),
+            slab.poff[..., None].astype(jnp.float32),
+            slab.pvlen[..., None].astype(jnp.float32),
+        ],
+        axis=-1,
+    )
+
+
+def _rows(ptrs: jnp.ndarray, hit: jnp.ndarray):
+    """Extract each walker's entry row from the packed pointer tensor:
+    ``[P, E] one-hot x [E, MP*(D+3)] -> [P, MP, D+3]`` — one f32 matmul."""
+    E, MP, C = ptrs.shape
+    rows = jnp.einsum(
+        "pe,ec->pc",
+        hit.astype(jnp.float32),
+        ptrs.reshape(E, MP * C),
+        preferred_element_type=jnp.float32,
+    )
+    return rows.reshape(-1, MP, C)
+
+
+def _compat_rows(qver, qlen, pv, pl):
+    """``DeweyVersion.isCompatible`` vectorized over walkers x pointers:
+    ``qver [P, D]`` (f32), ``qlen [P]``, ``pv [P, MP, D]`` (f32),
+    ``pl [P, MP]`` — mirrors ``ops/dewey_ops.is_compatible``."""
+    D = qver.shape[-1]
+    idx = jnp.arange(D, dtype=jnp.float32)
+    eq = qver[:, None, :] == pv
+    prefix_full = jnp.all(jnp.where(idx < pl[..., None], eq, True), axis=-1)
+    prefix_butlast = jnp.all(
+        jnp.where(idx < pl[..., None] - 1, eq, True), axis=-1
+    )
+    last_q = jnp.sum(jnp.where(idx == pl[..., None] - 1, qver[:, None, :], 0), axis=-1)
+    last_p = jnp.sum(jnp.where(idx == pl[..., None] - 1, pv, 0), axis=-1)
+    longer = (qlen[:, None] > pl) & prefix_full
+    equal = (qlen[:, None] == pl) & prefix_butlast & (last_q >= last_p)
+    return longer | equal
+
+
+def branch_batched(
+    slab: SlabState, en, stage, off, ver, vlen, max_walk: int
+) -> SlabState:
+    """All branch refcount walks of one step, in lockstep
+    (``KVSharedVersionedBuffer.java:99-110``).
+
+    Per-hop refcount increments are summed across walkers — increments
+    commute and pointer selection never reads refcounts, so the result is
+    identical to any sequential interleaving.  The hop loop is a
+    ``while_loop`` that exits as soon as no walker is active — the common
+    case (no branching this event) costs one condition check.
+    """
+    E, MP = slab.pstage.shape
+    D = slab.pver.shape[-1]
+    i32 = jnp.int32
+    mp_idx = jnp.arange(MP, dtype=i32)
+    ptrs = _pack_ptrs(slab)  # read-only in this phase
+
+    def cond(carry):
+        slab, stage, off, qver, qlen, active, hops = carry
+        return jnp.any(active) & (hops < max_walk)
+
+    def body(carry):
+        slab, stage, off, qver, qlen, active, hops = carry
+        hit = (slab.stage[None, :] == stage[:, None]) & (
+            slab.off[None, :] == off[:, None]
+        )
+        found = jnp.any(hit, axis=1)
+        slab = slab._replace(
+            missing=slab.missing + jnp.sum((active & ~found).astype(i32))
+        )
+        active = active & found
+        inc = jnp.sum((hit & active[:, None]).astype(i32), axis=0)
+        slab = slab._replace(refs=slab.refs + inc)
+
+        rows = _rows(ptrs, hit & active[:, None])  # [P, MP, D+3]
+        pv, ps, po, pl = (
+            rows[..., :D],
+            rows[..., D],
+            rows[..., D + 1],
+            rows[..., D + 2],
+        )
+        np_ = jnp.sum(jnp.where(hit, slab.npreds[None, :], 0), axis=1)
+        ok = _compat_rows(qver, qlen, pv, pl) & (mp_idx[None, :] < np_[:, None])
+        j = jnp.argmax(ok, axis=1)
+        sel = jnp.any(ok, axis=1)
+        ohj = mp_idx[None, :] == j[:, None]
+        ns = jnp.sum(jnp.where(ohj, ps, 0), axis=1)
+        active = active & sel & (ns >= 0)
+        stage = jnp.where(active, ns.astype(i32), stage)
+        off = jnp.where(
+            active, jnp.sum(jnp.where(ohj, po, 0), axis=1).astype(i32), off
+        )
+        qver = jnp.where(
+            active[:, None], jnp.sum(jnp.where(ohj[..., None], pv, 0), axis=1), qver
+        )
+        qlen = jnp.where(
+            active, jnp.sum(jnp.where(ohj, pl, 0), axis=1).astype(i32), qlen
+        )
+        return (slab, stage, off, qver, qlen, active, hops + 1)
+
+    init = (
+        slab,
+        jnp.asarray(stage, i32),
+        jnp.asarray(off, i32),
+        jnp.asarray(ver, jnp.float32),
+        jnp.asarray(vlen, i32),
+        jnp.asarray(en),
+        jnp.zeros((), i32),
+    )
+    slab, _, _, _, _, active, _ = jax.lax.while_loop(cond, body, init)
+    return slab._replace(
+        trunc=slab.trunc + jnp.sum(active.astype(i32))
+    )
+
+
+def peek_batched(
+    slab: SlabState,
+    en,
+    stage,
+    off,
+    ver,
+    vlen,
+    max_walk: int,
+    remove: bool,
+):
+    """All removal walks of one step in lockstep
+    (``KVSharedVersionedBuffer.java:135-171``).
+
+    Same-entry encounters need no serialization under the engine's
+    refcount invariant: every additional run lineage referencing a node
+    went through ``branch()`` (+1), so ``refs >= #remaining traversers``
+    at every node.  Consequently only the *last* traverser can observe
+    ``refs == 0`` — intermediate decrements never delete or prune — and
+    summed decrements with delete/prune attributed to the queue-last
+    same-hop walker reproduce the sequential queue order.  One knowingly
+    unobservable deviation: when walkers reach an entry at *different*
+    hops, the hop-last (not necessarily queue-last) one brings refs to
+    zero and prunes its own selected pointer, which on a zombie entry
+    (``refs == 0`` but ``npreds > 1``, so not deleted) may tombstone a
+    different pointer than the literal order would.  Zombies are never
+    traversed again — a walker reaching a node implies a live run path
+    through it, i.e. ``refs >= 1`` — and puts only ever target the current
+    event's offset, so the difference cannot be read back.
+    (Differentially validated against the literal sequential order by
+    ``tests/test_slab_batched.py`` and the engine fuzz suite.)
+
+    Returns ``(slab, out_stage [P, W], out_off [P, W], count [P])``.
+    """
+    E, MP = slab.pstage.shape
+    D = slab.pver.shape[-1]
+    P = jnp.asarray(stage).shape[0]
+    W = max_walk
+    i32 = jnp.int32
+    f32 = jnp.float32
+    mp_idx = jnp.arange(MP, dtype=i32)
+    pidx = jnp.arange(P, dtype=i32)
+    later = pidx[None, :] > pidx[:, None]
+
+    ptrs = _pack_ptrs(slab)  # read-only: prunes are tombstoned, not shifted
+    valid0 = mp_idx[None, :] < slab.npreds[:, None]  # [E, MP] at phase start
+
+    def cond(carry):
+        active = carry[6]
+        hops = carry[11]
+        return jnp.any(active) & (hops < W)
+
+    def body(carry):
+        (slab, dead, stage, off, qver, qlen, active, out_stage, out_off,
+         count, trunc, hops) = carry
+        hit = (slab.stage[None, :] == stage[:, None]) & (
+            slab.off[None, :] == off[:, None]
+        )
+        found = jnp.any(hit, axis=1)
+        slab = slab._replace(
+            missing=slab.missing + jnp.sum((active & ~found).astype(i32))
+        )
+        active = active & found
+        ham = hit & active[:, None]  # [P, E]
+        ham_f = ham.astype(f32)
+
+        m1 = jnp.any(ham, axis=0)  # [E] entries visited
+        dec = jnp.sum(ham.astype(i32), axis=0)
+        refs_left_e = jnp.maximum(slab.refs - dec, 0)
+        refs_left = jnp.sum(jnp.where(ham, refs_left_e[None, :], 0), axis=1)
+        slab = slab._replace(refs=jnp.where(m1, refs_left_e, slab.refs))
+
+        # Queue-last walker at each entry — the only one that may observe
+        # refs == 0 in the sequential order.
+        e = jnp.argmax(hit, axis=1)
+        last = active & ~jnp.any(
+            (e[None, :] == e[:, None]) & later & active[None, :], axis=1
+        )
+
+        live = valid0 & ~dead  # [E, MP]
+        np_live_e = jnp.sum(live.astype(i32), axis=1)  # [E]
+        np_live = jnp.sum(jnp.where(ham, np_live_e[None, :], 0), axis=1)
+        delete = last & remove & (refs_left == 0) & (np_live <= 1)
+        md = jnp.any(hit & delete[:, None], axis=0)
+        slab = slab._replace(
+            stage=jnp.where(md, -1, slab.stage),
+            off=jnp.where(md, -1, slab.off),
+        )
+
+        # Emit the hop into each walker's next output slot.
+        mw = (jnp.arange(W, dtype=i32)[None, :] == count[:, None]) & active[:, None]
+        out_stage = jnp.where(mw, stage[:, None], out_stage)
+        out_off = jnp.where(mw, off[:, None], out_off)
+        count = count + jnp.where(active, 1, 0)
+
+        rows = _rows(ptrs, ham)
+        pv, ps, po, pl = (
+            rows[..., :D],
+            rows[..., D],
+            rows[..., D + 1],
+            rows[..., D + 2],
+        )
+        # Selection sees exactly the sequential pointer list: original
+        # insertion order with pruned pointers masked out.
+        live_p = jnp.einsum(
+            "pe,em->pm", ham_f, live.astype(f32), preferred_element_type=f32
+        ) > 0.5
+        ok = _compat_rows(qver, qlen, pv, pl) & live_p
+        j = jnp.argmax(ok, axis=1)
+        sel = jnp.any(ok, axis=1) & active
+        prune = sel & remove & last & (refs_left == 0)
+
+        # Tombstone the traversed pointer; physical compaction is deferred
+        # to the end of the phase (ptrs stays read-only per hop).
+        ohj = mp_idx[None, :] == j[:, None]
+        tomb = jnp.einsum(
+            "pe,pm->em", (hit & prune[:, None]).astype(f32), ohj.astype(f32),
+            preferred_element_type=f32,
+        ) > 0.5
+        dead = dead | tomb
+        slab = slab._replace(
+            npreds=slab.npreds - jnp.sum(tomb.astype(i32), axis=1)
+        )
+
+        ns = jnp.sum(jnp.where(ohj, ps, 0), axis=1)
+        nactive = sel & (ns >= 0)
+        stage = jnp.where(nactive, ns.astype(i32), stage)
+        off = jnp.where(
+            nactive, jnp.sum(jnp.where(ohj, po, 0), axis=1).astype(i32), off
+        )
+        qver = jnp.where(
+            nactive[:, None], jnp.sum(jnp.where(ohj[..., None], pv, 0), axis=1), qver
+        )
+        qlen = jnp.where(
+            nactive, jnp.sum(jnp.where(ohj, pl, 0), axis=1).astype(i32), qlen
+        )
+        # A walker that just spent its W-th hop stops; if it still had
+        # somewhere to go, that walk was truncated (counted).
+        budget_out = active & (count >= W)
+        trunc = trunc + jnp.sum((budget_out & nactive).astype(i32))
+        active = nactive & ~budget_out
+        return (slab, dead, stage, off, qver, qlen, active, out_stage,
+                out_off, count, trunc, hops + 1)
+
+    init = (
+        slab,
+        jnp.zeros((E, MP), bool),
+        jnp.asarray(stage, i32),
+        jnp.asarray(off, i32),
+        jnp.asarray(ver, jnp.float32),
+        jnp.asarray(vlen, i32),
+        jnp.asarray(en),
+        jnp.full((P, W), -1, i32),
+        jnp.full((P, W), -1, i32),
+        jnp.zeros((P,), i32),
+        jnp.zeros((), i32),
+        jnp.zeros((), i32),
+    )
+    (slab, dead, _, _, _, _, active, out_stage, out_off, count, trunc, _) = (
+        jax.lax.while_loop(cond, body, init)
+    )
+
+    # Apply tombstones: stable-compact surviving pointers to the front of
+    # each touched entry — the layout sequential shifting would have left.
+    any_dead = jnp.any(dead, axis=1)
+    live = valid0 & ~dead
+    tgt = jnp.cumsum(live.astype(i32), axis=1) - 1
+    perm = live[:, :, None] & (mp_idx[None, None, :] == tgt[:, :, None])
+
+    def comp2(field):
+        v = jnp.sum(jnp.where(perm, field[:, :, None], 0), axis=1)
+        return jnp.where(any_dead[:, None], v.astype(field.dtype), field)
+
+    def comp3(field):
+        v = jnp.sum(jnp.where(perm[..., None], field[:, :, None, :], 0), axis=1)
+        return jnp.where(any_dead[:, None, None], v.astype(field.dtype), field)
+
+    slab = slab._replace(
+        pstage=comp2(slab.pstage),
+        poff=comp2(slab.poff),
+        pvlen=comp2(slab.pvlen),
+        pver=comp3(slab.pver),
+        # Walks cut off by the trip cap leak their untraversed tails,
+        # exactly like the sequential bound (counted).
+        trunc=slab.trunc + trunc + jnp.sum(active.astype(i32)),
+    )
+    return slab, out_stage, out_off, count
 
 
 # Eager per-op dispatch is orders of magnitude slower than compiled code on

@@ -86,6 +86,7 @@ def save_checkpoint(processor: CEPProcessor, path: str) -> None:
         "dedup": processor.dedup,
         "lane_of": dict(processor._lane_of),
         "next_offset": processor._next_offset.copy(),
+        "off_base": processor._off_base.copy(),
         "events": [dict(d) for d in processor._events],
         "value_proto": processor._value_proto,
     }
@@ -146,6 +147,13 @@ def restore_processor(pattern, path: str) -> CEPProcessor:
     proc._lane_of = dict(header["lane_of"])
     proc._key_of = {v: k for k, v in proc._lane_of.items()}
     proc._next_offset = np.asarray(header["next_offset"]).copy()
+    if "off_base" in header:
+        proc._off_base = np.asarray(header["off_base"]).copy()
+    else:
+        # Pre-rebasing checkpoint: lanes that already saw records hold
+        # absolute (unrebased) device offsets, so their base must stay 0;
+        # untouched lanes stay unset so their first record fixes a base.
+        proc._off_base = np.where(proc._next_offset > 0, 0, -1).astype(np.int64)
     proc._events = [dict(d) for d in header["events"]]
     proc._value_proto = header["value_proto"]
     logger.info(

@@ -29,7 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kafkastreams_cep_tpu import native
-from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.engine.matcher import (
+    OFFSET_LIMIT,
+    EngineConfig,
+    EventBatch,
+)
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 from kafkastreams_cep_tpu.utils.metrics import Metrics
@@ -118,6 +122,12 @@ class CEPProcessor:
         self._lane_of: Dict[Hashable, int] = {}
         self._key_of: Dict[int, Hashable] = {}
         self._next_offset = np.zeros(self.num_lanes, dtype=np.int64)
+        # Per-lane offset base: the engine sees offsets rebased to log
+        # positions (device offsets must stay < 2^24 for the slab's f32
+        # pointer packing, engine.matcher.OFFSET_LIMIT); the first record of
+        # a lane fixes its base, like `epoch` does for timestamps.
+        self._off_base = np.full(self.num_lanes, -1, dtype=np.int64)
+        # Host event mirror, keyed by *device* (rebased) offset per lane.
         self._events: List[Dict[int, Event]] = [dict() for _ in range(self.num_lanes)]
         self._value_proto = None
         self.metrics = Metrics()
@@ -200,6 +210,7 @@ class CEPProcessor:
             lanes.append(lane)
         rel_ts = [self._rebased_ts(rec.timestamp) for rec in records]
         next_sim = self._next_offset.copy()
+        base_sim = self._off_base.copy()
         offsets: List[Optional[int]] = []
         batch_leaves = []
         for rank, rec in enumerate(records):
@@ -221,6 +232,21 @@ class CEPProcessor:
             if self.dedup and off < next_sim[lane]:
                 offsets.append(None)  # duplicate — high-water mark drop
             else:
+                if base_sim[lane] < 0:
+                    base_sim[lane] = off  # first record fixes the lane base
+                dev = off - int(base_sim[lane])
+                if dev < 0:
+                    raise ValueError(
+                        f"record {rank}: offset {off} is below lane "
+                        f"{lane}'s base {int(base_sim[lane])} (out-of-order "
+                        "replay below the first seen offset needs dedup=True)"
+                    )
+                if dev >= OFFSET_LIMIT:
+                    raise ValueError(
+                        f"record {rank}: offset {off} is {dev} past lane "
+                        f"{lane}'s base — per-lane log positions must stay "
+                        f"below 2^24 (engine f32 pointer packing)"
+                    )
                 offsets.append(off)
                 next_sim[lane] = max(next_sim[lane], off + 1)
 
@@ -231,7 +257,9 @@ class CEPProcessor:
                 self._key_of[lane] = key
                 logger.info("assigned key %r to lane %d", key, lane)
 
-        # Host-event bookkeeping (the decode mirror), one pass.
+        # Host-event bookkeeping (the decode mirror), one pass.  Events keep
+        # their true source offsets; the mirror is keyed by device offset.
+        self._off_base = base_sim
         dropped = 0
         for rank, rec in enumerate(records):
             off = offsets[rank]
@@ -243,7 +271,7 @@ class CEPProcessor:
             event = Event(
                 rec.key, rec.value, int(rec.timestamp), self.topic, lane, off
             )
-            self._events[lane][off] = event
+            self._events[lane][off - int(self._off_base[lane])] = event
         self.metrics.duplicates_dropped += dropped
         if dropped:
             logger.info("dropped %d replayed records (high-water mark)", dropped)
@@ -270,7 +298,10 @@ class CEPProcessor:
         )
         ts_col = np.asarray(rel_ts, dtype=np.int32)
         off_col = np.fromiter(
-            (off if off is not None else 0 for off in offsets),
+            (
+                off - int(self._off_base[lanes[rank]]) if off is not None else 0
+                for rank, off in enumerate(offsets)
+            ),
             dtype=np.int32,
             count=n,
         )

@@ -150,6 +150,11 @@ def test_parse_json_lines_reject_contract(label, use_native):
         (b'{"price":0x1A,"volume":2}', False),  # hex is not JSON
         (b'{"price":-1.5e2,"volume":2}', True),  # full JSON number grammar
         (b'{"price":1,"volume":2,"note":"ok"}', True),  # extra string field
+        (b'{"price":01,"volume":2}', False),  # leading zero is not JSON
+        (b'{"price":1.,"volume":2}', False),  # bare trailing dot
+        (b'{"price":1.e3,"volume":2}', False),  # frac digits required
+        (b'{"price":0.5e+1,"volume":2}', True),  # zero int part + signed exp
+        (b'\xff{"price":1,"volume":2}', False),  # invalid bytes reject, not crash
     ]
     text = b"\n".join(c for c, _ in cases)
     values, keys, ok = _with_path(
@@ -157,7 +162,21 @@ def test_parse_json_lines_reject_contract(label, use_native):
         lambda: native.parse_json_lines(text, ["price", "volume"], "name"),
     )
     assert ok.tolist() == [want for _, want in cases]
-    np.testing.assert_allclose(values[-2], [-150.0, 2.0])
+    idx = [c for c, _ in cases].index(b'{"price":-1.5e2,"volume":2}')
+    np.testing.assert_allclose(values[idx], [-150.0, 2.0])
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_parse_json_lines_huge_integer_is_inf(label, use_native):
+    # strtod saturates huge literals to ±HUGE_VAL; the fallback must match
+    # rather than crash with OverflowError.
+    text = ('{"price":1' + "0" * 400 + ',"volume":-1' + "0" * 400 + "}").encode()
+    values, keys, ok = _with_path(
+        use_native,
+        lambda: native.parse_json_lines(text, ["price", "volume"]),
+    )
+    assert ok.tolist() == [True]
+    assert values[0, 0] == np.inf and values[0, 1] == -np.inf
 
 
 @pytest.mark.parametrize("label,use_native", list(_both_paths()))
@@ -169,3 +188,25 @@ def test_parse_json_lines_empty_key_is_none(label, use_native):
     )
     assert ok.tolist() == [True]
     assert keys == [None]
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_parse_json_lines_duplicate_key_field_last_wins(label, use_native):
+    text = b'{"name":"abcdef","name":"x","price":1,"volume":2}'
+    values, keys, ok = _with_path(
+        use_native,
+        lambda: native.parse_json_lines(text, ["price", "volume"], "name"),
+    )
+    assert ok.tolist() == [True]
+    assert keys == ["x"]
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_parse_json_lines_empty_input(label, use_native):
+    values, keys, ok = _with_path(
+        use_native,
+        lambda: native.parse_json_lines(b"", ["price", "volume"], "name"),
+    )
+    assert values.shape == (0, 2)
+    assert keys == []
+    assert ok.shape == (0,)

@@ -1,0 +1,292 @@
+"""Batched slab kernels vs the sequential ops they replace.
+
+The batched kernels (``puts_batched``/``branch_batched``/``peek_batched``)
+claim per-entry op ordering identical to applying the sequential entry
+points one op at a time in the same order.  These tests build randomized op
+sets — including adversarial shared-path/shared-entry cases — and assert
+the resulting slab states match field-for-field.
+
+The engine-level equivalence (sequential_slab=True vs False) is covered by
+``test_ab_engine_paths`` on a branching-heavy trace.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu.engine import EngineConfig, TPUMatcher
+from kafkastreams_cep_tpu.engine.matcher import MatcherSession
+from kafkastreams_cep_tpu.ops import dewey_ops
+from kafkastreams_cep_tpu.ops import slab as slab_mod
+
+E, MP, D, W = 16, 4, 6, 8
+
+
+def canon_slab(s):
+    """Zero out semantically-dead storage so comparisons see only live state:
+    pointer slots at index >= npreds (stale leftovers of overwrites/prunes)
+    and all per-entry fields of free slots (stage < 0).  Both paths mask
+    these regions on every read, so they are free to differ."""
+    stage = np.asarray(s.stage)
+    off = np.asarray(s.off)
+    refs = np.asarray(s.refs).copy()
+    npreds = np.asarray(s.npreds).copy()
+    pstage = np.asarray(s.pstage).copy()
+    poff = np.asarray(s.poff).copy()
+    pver = np.asarray(s.pver).copy()
+    pvlen = np.asarray(s.pvlen).copy()
+    live = stage >= 0
+    slot_live = live[:, None] & (np.arange(pstage.shape[1])[None, :] < npreds[:, None])
+    pstage[~slot_live] = 0
+    poff[~slot_live] = 0
+    pver[~slot_live] = 0
+    pvlen[~slot_live] = 0
+    refs[~live] = 0
+    npreds[~live] = 0
+    return dict(
+        stage=stage, off=np.where(live, off, -1), refs=refs, npreds=npreds,
+        pstage=pstage, poff=poff, pver=pver, pvlen=pvlen,
+        full_drops=np.asarray(s.full_drops), pred_drops=np.asarray(s.pred_drops),
+        missing=np.asarray(s.missing), trunc=np.asarray(s.trunc),
+    )
+
+
+def assert_slab_equal(a, b, msg=""):
+    ca, cb = canon_slab(a), canon_slab(b)
+    for name in ca:
+        np.testing.assert_array_equal(
+            ca[name], cb[name], err_msg=f"{msg} field {name}"
+        )
+
+
+def mkver(*comps):
+    v, l = dewey_ops.make(comps, D)
+    return jnp.asarray(v), jnp.asarray(l)
+
+
+def seed_slab(rng, n_entries=6, max_off=4):
+    """A slab pre-populated through the sequential API (chains of puts)."""
+    slab = slab_mod.make(E, MP, D)
+    # A couple of chained runs sharing prefixes.
+    v1, l1 = mkver(1)
+    v10, l10 = mkver(1, 0)
+    v11, l11 = mkver(1, 1)
+    slab = slab_mod.put_first(slab, 0, 0, v1, l1)
+    slab = slab_mod.put(slab, 1, 1, 0, 0, v10, l10)
+    slab = slab_mod.put(slab, 1, 2, 1, 1, v10, l10)
+    slab = slab_mod.put(slab, 2, 3, 1, 2, v11, l11)
+    slab = slab_mod.put_first(slab, 0, 2, v11, l11)
+    return slab
+
+
+def random_put_ops(rng, P, cur_off):
+    en = rng.random(P) < 0.8
+    first = rng.random(P) < 0.3
+    cur_stage = rng.integers(0, 4, size=P)
+    prev_stage = rng.integers(0, 3, size=P)
+    prev_off = rng.integers(0, 4, size=P)
+    vers, vlens = [], []
+    for _ in range(P):
+        comps = tuple(rng.integers(1, 3, size=rng.integers(1, 4)))
+        v, l = dewey_ops.make(comps, D)
+        vers.append(v)
+        vlens.append(l)
+    return slab_mod.PutOps(
+        en=jnp.asarray(en),
+        first=jnp.asarray(first),
+        cur_stage=jnp.asarray(cur_stage, jnp.int32),
+        prev_stage=jnp.where(jnp.asarray(first), -1, jnp.asarray(prev_stage, jnp.int32)),
+        prev_off=jnp.where(jnp.asarray(first), -1, jnp.asarray(prev_off, jnp.int32)),
+        ver=jnp.asarray(np.stack(vers)),
+        vlen=jnp.asarray(np.stack(vlens)),
+    )
+
+
+def puts_sequential(slab, ops, off):
+    P = int(ops.en.shape[0])
+    for p in range(P):
+        slab = slab_mod.put_first(
+            slab, ops.cur_stage[p], off, ops.ver[p], ops.vlen[p],
+            enable=ops.en[p] & ops.first[p],
+        )
+        slab = slab_mod.put(
+            slab, ops.cur_stage[p], off, ops.prev_stage[p], ops.prev_off[p],
+            ops.ver[p], ops.vlen[p], enable=ops.en[p] & ~ops.first[p],
+        )
+    return slab
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_puts_batched_matches_sequential(seed):
+    rng = np.random.default_rng(seed)
+    slab0 = seed_slab(rng)
+    ops = random_put_ops(rng, P=10, cur_off=7)
+    seq = puts_sequential(slab0, ops, jnp.int32(7))
+    bat = slab_mod.puts_batched(slab0, ops, jnp.int32(7))
+    assert_slab_equal(seq, bat, f"seed={seed}")
+
+
+def test_puts_batched_first_reset_erases_earlier_appends():
+    rng = np.random.default_rng(0)
+    slab0 = seed_slab(rng)
+    v, l = mkver(2)
+    ops = slab_mod.PutOps(
+        en=jnp.asarray([True, True, True]),
+        first=jnp.asarray([False, True, False]),
+        cur_stage=jnp.asarray([3, 3, 3], jnp.int32),
+        prev_stage=jnp.asarray([1, -1, 2], jnp.int32),
+        prev_off=jnp.asarray([1, -1, 3], jnp.int32),
+        ver=jnp.stack([v, v, v]),
+        vlen=jnp.stack([l, l, l]),
+    )
+    seq = puts_sequential(slab0, ops, jnp.int32(9))
+    bat = slab_mod.puts_batched(slab0, ops, jnp.int32(9))
+    assert_slab_equal(seq, bat)
+    # After the reset, the entry holds the null pointer then op 3's pointer.
+    e = int(jnp.argmax((bat.stage == 3) & (bat.off == 9)))
+    assert int(bat.npreds[e]) == 2
+    assert int(bat.pstage[e, 0]) == -1 and int(bat.pstage[e, 1]) == 2
+
+
+def branch_sequential(slab, en, stage, off, ver, vlen):
+    for p in range(int(en.shape[0])):
+        slab = slab_mod.branch(
+            slab, stage[p], off[p], ver[p], vlen[p], W, enable=en[p]
+        )
+    return slab
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_branch_batched_matches_sequential(seed):
+    rng = np.random.default_rng(100 + seed)
+    slab0 = seed_slab(rng)
+    P = 6
+    en = jnp.asarray(rng.random(P) < 0.7)
+    stage = jnp.asarray(rng.integers(0, 4, size=P), jnp.int32)
+    off = jnp.asarray(rng.integers(0, 5, size=P), jnp.int32)
+    vers, vlens = [], []
+    for _ in range(P):
+        comps = tuple(rng.integers(1, 3, size=rng.integers(1, 3)))
+        v, l = dewey_ops.make(comps, D)
+        vers.append(v)
+        vlens.append(l)
+    ver = jnp.asarray(np.stack(vers))
+    vlen = jnp.asarray(np.stack(vlens))
+    seq = branch_sequential(slab0, en, stage, off, ver, vlen)
+    bat = slab_mod.branch_batched(slab0, en, stage, off, ver, vlen, W)
+    assert_slab_equal(seq, bat, f"seed={seed}")
+
+
+def peek_sequential(slab, en, stage, off, ver, vlen, remove=True):
+    outs = []
+    for p in range(int(en.shape[0])):
+        slab, st, of, cnt = slab_mod.peek(
+            slab, stage[p], off[p], ver[p], vlen[p], W,
+            remove=remove, enable=en[p],
+        )
+        outs.append((np.asarray(st), np.asarray(of), int(cnt)))
+    return slab, outs
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_peek_batched_matches_sequential(seed):
+    """Random walkers, including deliberate shared-entry starts.
+
+    Engine states maintain the invariant that every additional run lineage
+    referencing a buffer node went through ``branch()`` (+1 refcount), so a
+    node can never be deleted/pruned from under a walker that still has to
+    traverse it.  The test reproduces that invariant by branching once per
+    extra walker before removing — without it, sequential and lockstep
+    removal orders are legitimately distinguishable (and such states are
+    unreachable through the engine; see ``peek_batched``'s docstring).
+    """
+    rng = np.random.default_rng(200 + seed)
+    slab0 = seed_slab(rng)
+    P = 5
+    # Half the walkers start at the shared chain head (2, 3) to force
+    # same-entry same-hop conflicts.
+    stage = np.where(rng.random(P) < 0.5, 2, rng.integers(0, 4, size=P))
+    off = np.where(stage == 2, 3, rng.integers(0, 5, size=P))
+    en = jnp.asarray(rng.random(P) < 0.8)
+    vers, vlens = [], []
+    for _ in range(P):
+        comps = tuple(rng.integers(1, 3, size=rng.integers(1, 4)))
+        v, l = dewey_ops.make(comps, D)
+        vers.append(v)
+        vlens.append(l)
+    ver = jnp.asarray(np.stack(vers))
+    vlen = jnp.asarray(np.stack(vlens))
+    stage = jnp.asarray(stage, jnp.int32)
+    off = jnp.asarray(off, jnp.int32)
+
+    # Refcount invariant: one branch per walker beyond the first.
+    for p in range(1, P):
+        slab0 = slab_mod.branch(
+            slab0, stage[p], off[p], ver[p], vlen[p], W, enable=en[p]
+        )
+
+    seq_slab, seq_outs = peek_sequential(slab0, en, stage, off, ver, vlen)
+    bat_slab, b_st, b_of, b_cnt = slab_mod.peek_batched(
+        slab0, en, stage, off, ver, vlen, W, remove=True
+    )
+    assert_slab_equal(seq_slab, bat_slab, f"seed={seed}")
+    for p, (st, of, cnt) in enumerate(seq_outs):
+        assert int(b_cnt[p]) == cnt, f"walker {p} count"
+        np.testing.assert_array_equal(np.asarray(b_st[p]), st, f"walker {p}")
+        np.testing.assert_array_equal(np.asarray(b_of[p]), of, f"walker {p}")
+
+
+def test_ab_engine_paths():
+    """Engine-level A/B: sequential_slab True vs False on a branching-heavy
+    skip-till-any trace must produce identical matches and counters."""
+    from kafkastreams_cep_tpu import Query
+
+    def pattern():
+        return (
+            Query()
+            .select("a").skip_till_any_match()
+            .where(lambda k, v, ts, st: (v % 3) == 0)
+            .then()
+            .select("b").skip_till_any_match()
+            .where(lambda k, v, ts, st: (v % 3) == 1)
+            .then()
+            .select("c")
+            .where(lambda k, v, ts, st: (v % 3) == 2)
+            .build()
+        )
+
+    cfg_kw = dict(
+        max_runs=24, slab_entries=96, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    rng = np.random.default_rng(7)
+    values = rng.integers(0, 6, size=40).tolist()
+
+    results = []
+    for sequential in (True, False):
+        m = TPUMatcher(
+            pattern(), EngineConfig(sequential_slab=sequential, **cfg_kw)
+        )
+        sess = MatcherSession(m)
+        all_matches = []
+        for i, v in enumerate(values):
+            for s in sess.match(None, int(v), 1000 + i, offset=i):
+                all_matches.append(
+                    tuple(
+                        (name, tuple(e.offset for e in evs))
+                        for name, evs in s.as_map().items()
+                    )
+                )
+        results.append((all_matches, sess.counters()))
+    assert results[0][0] == results[1][0]
+    # All capacity/overflow counters must agree.  `missing` may legitimately
+    # differ: it diagnoses states where the reference NPEs (a dead run's
+    # removal deleting an entry a later same-step op references,
+    # KVSharedVersionedBuffer.java:86-89); the batched phase order reaches
+    # fewer of those lookups than the literal per-run interleave, while
+    # match output stays identical (asserted above).
+    seq_counters, bat_counters = results[0][1], results[1][1]
+    for name in seq_counters:
+        if name != "slab_missing":
+            assert seq_counters[name] == bat_counters[name], name
