@@ -181,6 +181,11 @@ OFFSET_LIMIT = 1 << 24
 
 
 def check_offset(offset: int) -> int:
+    if offset < 0:
+        raise ValueError(
+            f"event offset {offset} is negative; -1 is the engine's "
+            "null-pointer sentinel, so offsets must be >= 0"
+        )
     if offset >= OFFSET_LIMIT:
         raise ValueError(
             f"event offset {offset} >= 2^24; the engine's f32 pointer packing "
@@ -557,7 +562,9 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             )
             slab = slab_mod.puts_batched(state.slab, ops, off)
 
-            # Branch walks, deepest-first within each run (unwind order).
+            # Branch walks, deepest-first within each run (unwind order) —
+            # separate so the common no-branch step early-exits the whole
+            # RH-walker phase after one condition check.
             def rev(f):
                 return f[:, ::-1].reshape((RH,) + f.shape[2:])
 
@@ -566,19 +573,29 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
                 rev(rec.br_ver), rev(rec.br_vlen), W,
             )
 
-            # Dead-run path removal, queue order (NFA.java:102-103,117-123).
+            # Dead-run removals (NFA.java:102-103,117-123) and final-match
+            # extraction (NFA.java:111-115), merged into one lockstep pass.
             dead_en = rec.dead & (state.event_off >= 0)
-            slab, _, _, _ = slab_mod.peek_batched(
-                slab, dead_en, jnp.maximum(state.id_pos, 0),
-                state.event_off, state.ver, state.vlen, W, remove=True,
+            w_en = jnp.concatenate([dead_en, final_en])
+            w_stage = jnp.concatenate(
+                [jnp.maximum(state.id_pos, 0), rec.surv_id]
             )
-
-            # Match construction for final states (NFA.java:111-115).
-            slab, out_stage, out_off, out_count = slab_mod.peek_batched(
-                slab, final_en, rec.surv_id,
-                jnp.broadcast_to(off, (R,)), rec.surv_ver, rec.surv_vlen,
-                W, remove=True,
+            w_off = jnp.concatenate(
+                [state.event_off, jnp.broadcast_to(off, (R,))]
             )
+            w_ver = jnp.concatenate([state.ver, rec.surv_ver])
+            w_vlen = jnp.concatenate([state.vlen, rec.surv_vlen])
+            w_remove = jnp.ones((2 * R,), bool)
+            w_out = jnp.concatenate(
+                [jnp.zeros((R,), bool), jnp.ones((R,), bool)]
+            )
+            slab, w_out_stage, w_out_off, w_count = slab_mod.walks_batched(
+                slab, w_en, w_stage, w_off, w_ver, w_vlen,
+                w_remove, w_out, W,
+            )
+            out_stage = w_out_stage[R:]
+            out_off = w_out_off[R:]
+            out_count = w_count[R:]
 
         # --- Next queue: per run [survivor, branches deepest-first, re-seed],
         # flattened in queue order, compacted into R slots (overflow counted).

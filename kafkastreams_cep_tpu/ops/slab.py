@@ -327,6 +327,191 @@ def live_entries(slab: SlabState) -> jnp.ndarray:
     return jnp.sum(slab.stage >= 0)
 
 
+def walks_batched(
+    slab: SlabState,
+    en,
+    stage,
+    off,
+    ver,
+    vlen,
+    is_remove,
+    want_out,
+    max_walk: int,
+    collect: bool = True,
+):
+    """ALL of one step's buffer walks — branch refcount walks, dead-run
+    removals, and final-match extractions — in a single lockstep pass.
+
+    ``is_remove[p]`` selects decrement/prune/delete semantics (dead/final
+    walkers) vs. increment semantics (branch walkers); ``want_out[p]``
+    walkers additionally emit their hops.  Merging the three phases is
+    sound by the same refcount invariant as :func:`peek_batched`:
+    per-entry refcount deltas commute (summed per hop), and only the last
+    remaining traverser of a node can observe ``refs == 0``, so
+    delete/prune attribution to the last same-hop remove-walker
+    reproduces the sequential outcome regardless of phase interleaving.
+    The engine-level A/B test (``sequential_slab``) and the oracle fuzz
+    suite validate the merged order end to end.
+
+    Returns ``(slab, out_stage [P, W], out_off [P, W], count [P])`` —
+    rows meaningful only where ``want_out``.
+    """
+    E, MP = slab.pstage.shape
+    D = slab.pver.shape[-1]
+    P = jnp.asarray(stage).shape[0]
+    W = max_walk
+    i32 = jnp.int32
+    f32 = jnp.float32
+    mp_idx = jnp.arange(MP, dtype=i32)
+    pidx = jnp.arange(P, dtype=i32)
+    later = pidx[None, :] > pidx[:, None]
+
+    # Safety of merging increments with removals: the only way a removal
+    # could collect a node an in-flight branch walk still needs is a
+    # refs==1 path shared by a branch walker and a dead walker of the SAME
+    # run (cross-run shared nodes always carry one ref per lineage, i.e.
+    # >= 2).  That cannot happen: a run that branches has a successor by
+    # definition (matcher.py: has_succ = survivor | any branch), so it is
+    # never dead in the same step.
+    is_remove = jnp.asarray(is_remove)
+    want_out = jnp.asarray(want_out)
+    ptrs = _pack_ptrs(slab)  # read-only: prunes are tombstoned, not shifted
+    valid0 = mp_idx[None, :] < slab.npreds[:, None]  # [E, MP] at phase start
+
+    def cond(carry):
+        active = carry[6]
+        hops = carry[11]
+        return jnp.any(active) & (hops < W)
+
+    def body(carry):
+        (slab, dead, stage, off, qver, qlen, active, out_stage, out_off,
+         count, trunc, hops) = carry
+        hit = (slab.stage[None, :] == stage[:, None]) & (
+            slab.off[None, :] == off[:, None]
+        )
+        found = jnp.any(hit, axis=1)
+        slab = slab._replace(
+            missing=slab.missing + jnp.sum((active & ~found).astype(i32))
+        )
+        active = active & found
+        ham = hit & active[:, None]  # [P, E]
+
+        m1 = jnp.any(ham, axis=0)
+        inc = jnp.sum((ham & ~is_remove[:, None]).astype(i32), axis=0)
+        dec = jnp.sum((ham & is_remove[:, None]).astype(i32), axis=0)
+        refs_after_e = jnp.maximum(slab.refs + inc - dec, 0)
+        refs_after = jnp.sum(jnp.where(ham, refs_after_e[None, :], 0), axis=1)
+        slab = slab._replace(refs=jnp.where(m1, refs_after_e, slab.refs))
+
+        # Queue-last remove-walker at each entry — the only one that may
+        # collect (prune/delete) when refs reaches zero.
+        arm = active & is_remove
+        e = jnp.argmax(hit, axis=1)
+        last = arm & ~jnp.any(
+            (e[None, :] == e[:, None]) & later & arm[None, :], axis=1
+        )
+
+        rows = _rows(ptrs, ham)
+        pv, ps, po, pl = (
+            rows[..., :D],
+            rows[..., D],
+            rows[..., D + 1],
+            rows[..., D + 2],
+        )
+        live = valid0 & ~dead  # [E, MP]
+        live_p = jnp.einsum(
+            "pe,em->pm", ham.astype(f32), live.astype(f32),
+            preferred_element_type=f32,
+        ) > 0.5
+        np_live = jnp.sum(live_p.astype(i32), axis=1)
+        delete = last & collect & (refs_after == 0) & (np_live <= 1)
+        md = jnp.any(hit & delete[:, None], axis=0)
+        slab = slab._replace(
+            stage=jnp.where(md, -1, slab.stage),
+            off=jnp.where(md, -1, slab.off),
+        )
+
+        # Emit the hop for extraction walkers.
+        emit = active & want_out
+        mw = (jnp.arange(W, dtype=i32)[None, :] == count[:, None]) & emit[:, None]
+        out_stage = jnp.where(mw, stage[:, None], out_stage)
+        out_off = jnp.where(mw, off[:, None], out_off)
+        count = count + jnp.where(emit, 1, 0)
+
+        ok = _compat_rows(qver, qlen, pv, pl) & live_p
+        j = jnp.argmax(ok, axis=1)
+        sel = jnp.any(ok, axis=1) & active
+        prune = sel & last & collect & (refs_after == 0)
+
+        ohj = mp_idx[None, :] == j[:, None]
+        tomb = jnp.einsum(
+            "pe,pm->em", (hit & prune[:, None]).astype(f32), ohj.astype(f32),
+            preferred_element_type=f32,
+        ) > 0.5
+        dead = dead | tomb
+        slab = slab._replace(
+            npreds=slab.npreds - jnp.sum(tomb.astype(i32), axis=1)
+        )
+
+        # Selected pointer row, all channels in one masked reduction.
+        sel_row = jnp.sum(jnp.where(ohj[:, :, None], rows, 0), axis=1)  # [P, C]
+        ns = sel_row[:, D]
+        nactive = sel & (ns >= 0)
+        stage = jnp.where(nactive, ns.astype(i32), stage)
+        off = jnp.where(nactive, sel_row[:, D + 1].astype(i32), off)
+        qver = jnp.where(nactive[:, None], sel_row[:, :D], qver)
+        qlen = jnp.where(nactive, sel_row[:, D + 2].astype(i32), qlen)
+
+        # Extraction walkers get W emitting hops; others walk at most W
+        # hops total (the while bound) — both truncations are counted.
+        budget_out = emit & (count >= W)
+        trunc = trunc + jnp.sum((budget_out & nactive).astype(i32))
+        active = nactive & ~budget_out
+        return (slab, dead, stage, off, qver, qlen, active, out_stage,
+                out_off, count, trunc, hops + 1)
+
+    init = (
+        slab,
+        jnp.zeros((E, MP), bool),
+        jnp.asarray(stage, i32),
+        jnp.asarray(off, i32),
+        jnp.asarray(ver, f32),
+        jnp.asarray(vlen, i32),
+        jnp.asarray(en),
+        jnp.full((P, W), -1, i32),
+        jnp.full((P, W), -1, i32),
+        jnp.zeros((P,), i32),
+        jnp.zeros((), i32),
+        jnp.zeros((), i32),
+    )
+    (slab, dead, _, _, _, _, active, out_stage, out_off, count, trunc, _) = (
+        jax.lax.while_loop(cond, body, init)
+    )
+
+    # Apply tombstones: stable-compact surviving pointers to the front.
+    any_dead = jnp.any(dead, axis=1)
+    live = valid0 & ~dead
+    tgt = jnp.cumsum(live.astype(i32), axis=1) - 1
+    perm = live[:, :, None] & (mp_idx[None, None, :] == tgt[:, :, None])
+
+    def comp2(field):
+        v = jnp.sum(jnp.where(perm, field[:, :, None], 0), axis=1)
+        return jnp.where(any_dead[:, None], v.astype(field.dtype), field)
+
+    def comp3(field):
+        v = jnp.sum(jnp.where(perm[..., None], field[:, :, None, :], 0), axis=1)
+        return jnp.where(any_dead[:, None, None], v.astype(field.dtype), field)
+
+    slab = slab._replace(
+        pstage=comp2(slab.pstage),
+        poff=comp2(slab.poff),
+        pvlen=comp2(slab.pvlen),
+        pver=comp3(slab.pver),
+        trunc=slab.trunc + trunc + jnp.sum(active.astype(i32)),
+    )
+    return slab, out_stage, out_off, count
+
+
 # ---------------------------------------------------------------------------
 # Batched per-step kernels
 #
@@ -527,21 +712,12 @@ def _rows(ptrs: jnp.ndarray, hit: jnp.ndarray):
 
 
 def _compat_rows(qver, qlen, pv, pl):
-    """``DeweyVersion.isCompatible`` vectorized over walkers x pointers:
+    """``dewey_ops.is_compatible`` vectorized over walkers x pointers:
     ``qver [P, D]`` (f32), ``qlen [P]``, ``pv [P, MP, D]`` (f32),
-    ``pl [P, MP]`` — mirrors ``ops/dewey_ops.is_compatible``."""
-    D = qver.shape[-1]
-    idx = jnp.arange(D, dtype=jnp.float32)
-    eq = qver[:, None, :] == pv
-    prefix_full = jnp.all(jnp.where(idx < pl[..., None], eq, True), axis=-1)
-    prefix_butlast = jnp.all(
-        jnp.where(idx < pl[..., None] - 1, eq, True), axis=-1
-    )
-    last_q = jnp.sum(jnp.where(idx == pl[..., None] - 1, qver[:, None, :], 0), axis=-1)
-    last_p = jnp.sum(jnp.where(idx == pl[..., None] - 1, pv, 0), axis=-1)
-    longer = (qlen[:, None] > pl) & prefix_full
-    equal = (qlen[:, None] == pl) & prefix_butlast & (last_q >= last_p)
-    return longer | equal
+    ``pl [P, MP]``.  One source of truth for the compatibility rule — the
+    masked elementwise math works identically on f32-encoded components."""
+    per_walker = jax.vmap(dewey_ops.is_compatible, in_axes=(None, None, 0, 0))
+    return jax.vmap(per_walker)(qver, qlen, pv, pl)
 
 
 def branch_batched(
@@ -630,188 +806,15 @@ def peek_batched(
     max_walk: int,
     remove: bool,
 ):
-    """All removal walks of one step in lockstep
-    (``KVSharedVersionedBuffer.java:135-171``).
-
-    Same-entry encounters need no serialization under the engine's
-    refcount invariant: every additional run lineage referencing a node
-    went through ``branch()`` (+1), so ``refs >= #remaining traversers``
-    at every node.  Consequently only the *last* traverser can observe
-    ``refs == 0`` — intermediate decrements never delete or prune — and
-    summed decrements with delete/prune attributed to the queue-last
-    same-hop walker reproduce the sequential queue order.  One knowingly
-    unobservable deviation: when walkers reach an entry at *different*
-    hops, the hop-last (not necessarily queue-last) one brings refs to
-    zero and prunes its own selected pointer, which on a zombie entry
-    (``refs == 0`` but ``npreds > 1``, so not deleted) may tombstone a
-    different pointer than the literal order would.  Zombies are never
-    traversed again — a walker reaching a node implies a live run path
-    through it, i.e. ``refs >= 1`` — and puts only ever target the current
-    event's offset, so the difference cannot be read back.
-    (Differentially validated against the literal sequential order by
-    ``tests/test_slab_batched.py`` and the engine fuzz suite.)
+    """Lockstep removal walks — a thin wrapper over :func:`walks_batched`
+    with every walker removing and emitting (``remove=False`` keeps the
+    reference's get-still-decrements quirk but skips delete/prune).
 
     Returns ``(slab, out_stage [P, W], out_off [P, W], count [P])``.
     """
-    E, MP = slab.pstage.shape
-    D = slab.pver.shape[-1]
     P = jnp.asarray(stage).shape[0]
-    W = max_walk
-    i32 = jnp.int32
-    f32 = jnp.float32
-    mp_idx = jnp.arange(MP, dtype=i32)
-    pidx = jnp.arange(P, dtype=i32)
-    later = pidx[None, :] > pidx[:, None]
-
-    ptrs = _pack_ptrs(slab)  # read-only: prunes are tombstoned, not shifted
-    valid0 = mp_idx[None, :] < slab.npreds[:, None]  # [E, MP] at phase start
-
-    def cond(carry):
-        active = carry[6]
-        hops = carry[11]
-        return jnp.any(active) & (hops < W)
-
-    def body(carry):
-        (slab, dead, stage, off, qver, qlen, active, out_stage, out_off,
-         count, trunc, hops) = carry
-        hit = (slab.stage[None, :] == stage[:, None]) & (
-            slab.off[None, :] == off[:, None]
-        )
-        found = jnp.any(hit, axis=1)
-        slab = slab._replace(
-            missing=slab.missing + jnp.sum((active & ~found).astype(i32))
-        )
-        active = active & found
-        ham = hit & active[:, None]  # [P, E]
-        ham_f = ham.astype(f32)
-
-        m1 = jnp.any(ham, axis=0)  # [E] entries visited
-        dec = jnp.sum(ham.astype(i32), axis=0)
-        refs_left_e = jnp.maximum(slab.refs - dec, 0)
-        refs_left = jnp.sum(jnp.where(ham, refs_left_e[None, :], 0), axis=1)
-        slab = slab._replace(refs=jnp.where(m1, refs_left_e, slab.refs))
-
-        # Queue-last walker at each entry — the only one that may observe
-        # refs == 0 in the sequential order.
-        e = jnp.argmax(hit, axis=1)
-        last = active & ~jnp.any(
-            (e[None, :] == e[:, None]) & later & active[None, :], axis=1
-        )
-
-        live = valid0 & ~dead  # [E, MP]
-        np_live_e = jnp.sum(live.astype(i32), axis=1)  # [E]
-        np_live = jnp.sum(jnp.where(ham, np_live_e[None, :], 0), axis=1)
-        delete = last & remove & (refs_left == 0) & (np_live <= 1)
-        md = jnp.any(hit & delete[:, None], axis=0)
-        slab = slab._replace(
-            stage=jnp.where(md, -1, slab.stage),
-            off=jnp.where(md, -1, slab.off),
-        )
-
-        # Emit the hop into each walker's next output slot.
-        mw = (jnp.arange(W, dtype=i32)[None, :] == count[:, None]) & active[:, None]
-        out_stage = jnp.where(mw, stage[:, None], out_stage)
-        out_off = jnp.where(mw, off[:, None], out_off)
-        count = count + jnp.where(active, 1, 0)
-
-        rows = _rows(ptrs, ham)
-        pv, ps, po, pl = (
-            rows[..., :D],
-            rows[..., D],
-            rows[..., D + 1],
-            rows[..., D + 2],
-        )
-        # Selection sees exactly the sequential pointer list: original
-        # insertion order with pruned pointers masked out.
-        live_p = jnp.einsum(
-            "pe,em->pm", ham_f, live.astype(f32), preferred_element_type=f32
-        ) > 0.5
-        ok = _compat_rows(qver, qlen, pv, pl) & live_p
-        j = jnp.argmax(ok, axis=1)
-        sel = jnp.any(ok, axis=1) & active
-        prune = sel & remove & last & (refs_left == 0)
-
-        # Tombstone the traversed pointer; physical compaction is deferred
-        # to the end of the phase (ptrs stays read-only per hop).
-        ohj = mp_idx[None, :] == j[:, None]
-        tomb = jnp.einsum(
-            "pe,pm->em", (hit & prune[:, None]).astype(f32), ohj.astype(f32),
-            preferred_element_type=f32,
-        ) > 0.5
-        dead = dead | tomb
-        slab = slab._replace(
-            npreds=slab.npreds - jnp.sum(tomb.astype(i32), axis=1)
-        )
-
-        ns = jnp.sum(jnp.where(ohj, ps, 0), axis=1)
-        nactive = sel & (ns >= 0)
-        stage = jnp.where(nactive, ns.astype(i32), stage)
-        off = jnp.where(
-            nactive, jnp.sum(jnp.where(ohj, po, 0), axis=1).astype(i32), off
-        )
-        qver = jnp.where(
-            nactive[:, None], jnp.sum(jnp.where(ohj[..., None], pv, 0), axis=1), qver
-        )
-        qlen = jnp.where(
-            nactive, jnp.sum(jnp.where(ohj, pl, 0), axis=1).astype(i32), qlen
-        )
-        # A walker that just spent its W-th hop stops; if it still had
-        # somewhere to go, that walk was truncated (counted).
-        budget_out = active & (count >= W)
-        trunc = trunc + jnp.sum((budget_out & nactive).astype(i32))
-        active = nactive & ~budget_out
-        return (slab, dead, stage, off, qver, qlen, active, out_stage,
-                out_off, count, trunc, hops + 1)
-
-    init = (
-        slab,
-        jnp.zeros((E, MP), bool),
-        jnp.asarray(stage, i32),
-        jnp.asarray(off, i32),
-        jnp.asarray(ver, jnp.float32),
-        jnp.asarray(vlen, i32),
-        jnp.asarray(en),
-        jnp.full((P, W), -1, i32),
-        jnp.full((P, W), -1, i32),
-        jnp.zeros((P,), i32),
-        jnp.zeros((), i32),
-        jnp.zeros((), i32),
+    ones = jnp.ones((P,), bool)
+    return walks_batched(
+        slab, en, stage, off, ver, vlen,
+        is_remove=ones, want_out=ones, max_walk=max_walk, collect=remove,
     )
-    (slab, dead, _, _, _, _, active, out_stage, out_off, count, trunc, _) = (
-        jax.lax.while_loop(cond, body, init)
-    )
-
-    # Apply tombstones: stable-compact surviving pointers to the front of
-    # each touched entry — the layout sequential shifting would have left.
-    any_dead = jnp.any(dead, axis=1)
-    live = valid0 & ~dead
-    tgt = jnp.cumsum(live.astype(i32), axis=1) - 1
-    perm = live[:, :, None] & (mp_idx[None, None, :] == tgt[:, :, None])
-
-    def comp2(field):
-        v = jnp.sum(jnp.where(perm, field[:, :, None], 0), axis=1)
-        return jnp.where(any_dead[:, None], v.astype(field.dtype), field)
-
-    def comp3(field):
-        v = jnp.sum(jnp.where(perm[..., None], field[:, :, None, :], 0), axis=1)
-        return jnp.where(any_dead[:, None, None], v.astype(field.dtype), field)
-
-    slab = slab._replace(
-        pstage=comp2(slab.pstage),
-        poff=comp2(slab.poff),
-        pvlen=comp2(slab.pvlen),
-        pver=comp3(slab.pver),
-        # Walks cut off by the trip cap leak their untraversed tails,
-        # exactly like the sequential bound (counted).
-        trunc=slab.trunc + trunc + jnp.sum(active.astype(i32)),
-    )
-    return slab, out_stage, out_off, count
-
-
-# Eager per-op dispatch is orders of magnitude slower than compiled code on
-# this host; the public entry points are jitted (the engine additionally
-# inlines them under its own jit, where these wrappers are free).
-put_first = jax.jit(put_first)
-put = jax.jit(put)
-branch = jax.jit(branch, static_argnames=("max_walk",))
-peek = jax.jit(peek, static_argnames=("max_walk", "remove"))

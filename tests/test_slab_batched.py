@@ -1,10 +1,14 @@
 """Batched slab kernels vs the sequential ops they replace.
 
-The batched kernels (``puts_batched``/``branch_batched``/``peek_batched``)
-claim per-entry op ordering identical to applying the sequential entry
-points one op at a time in the same order.  These tests build randomized op
-sets — including adversarial shared-path/shared-entry cases — and assert
-the resulting slab states match field-for-field.
+The batched kernels claim per-entry op ordering identical to applying the
+sequential entry points one op at a time in the same order.  These tests
+build randomized op sets — including adversarial shared-path/shared-entry
+cases — and assert the resulting slab states match field-for-field.
+Production coverage: the engine's batched path runs ``puts_batched``,
+``branch_batched``, and ``walks_batched`` (``peek_batched`` is a wrapper
+over the latter); each is differentially tested here, including
+``walks_batched`` with mixed increment/remove walkers — the merged
+branch+removal shape its docstring licenses.
 
 The engine-level equivalence (sequential_slab=True vs False) is covered by
 ``test_ab_engine_paths`` on a branching-heavy trace.
@@ -235,6 +239,60 @@ def test_peek_batched_matches_sequential(seed):
         assert int(b_cnt[p]) == cnt, f"walker {p} count"
         np.testing.assert_array_equal(np.asarray(b_st[p]), st, f"walker {p}")
         np.testing.assert_array_equal(np.asarray(b_of[p]), of, f"walker {p}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_walks_batched_mixed_matches_sequential(seed):
+    """Mixed increment (branch) + remove walkers in one merged pass vs the
+    sequential branch-then-peek order, on invariant-respecting states."""
+    rng = np.random.default_rng(300 + seed)
+    slab0 = seed_slab(rng)
+    PB, PR = 4, 4
+    b_stage = jnp.asarray(rng.integers(0, 4, size=PB), jnp.int32)
+    b_off = jnp.asarray(rng.integers(0, 5, size=PB), jnp.int32)
+    b_en = jnp.asarray(rng.random(PB) < 0.7)
+    r_stage = np.where(rng.random(PR) < 0.5, 2, rng.integers(0, 4, size=PR))
+    r_off = np.where(r_stage == 2, 3, rng.integers(0, 5, size=PR))
+    r_en = jnp.asarray(rng.random(PR) < 0.8)
+    vers, vlens = [], []
+    for _ in range(PB + PR):
+        comps = tuple(rng.integers(1, 3, size=rng.integers(1, 4)))
+        v, l = dewey_ops.make(comps, D)
+        vers.append(v)
+        vlens.append(l)
+    ver = jnp.asarray(np.stack(vers))
+    vlen = jnp.asarray(np.stack(vlens))
+    r_stage = jnp.asarray(r_stage, jnp.int32)
+    r_off = jnp.asarray(r_off, jnp.int32)
+
+    # Refcount invariant for the removers (one branch per extra walker).
+    for p in range(1, PR):
+        slab0 = slab_mod.branch(
+            slab0, r_stage[p], r_off[p], ver[PB + p], vlen[PB + p], W,
+            enable=r_en[p],
+        )
+
+    seq = branch_sequential(slab0, b_en, b_stage, b_off, ver[:PB], vlen[:PB])
+    seq, seq_outs = peek_sequential(
+        seq, r_en, r_stage, r_off, ver[PB:], vlen[PB:]
+    )
+
+    bat, b_st, b_of, b_cnt = slab_mod.walks_batched(
+        slab0,
+        jnp.concatenate([b_en, r_en]),
+        jnp.concatenate([b_stage, r_stage]),
+        jnp.concatenate([b_off, r_off]),
+        ver,
+        vlen,
+        is_remove=jnp.asarray([False] * PB + [True] * PR),
+        want_out=jnp.asarray([False] * PB + [True] * PR),
+        max_walk=W,
+    )
+    assert_slab_equal(seq, bat, f"seed={seed}")
+    for p, (st, of, cnt) in enumerate(seq_outs):
+        assert int(b_cnt[PB + p]) == cnt, f"walker {p} count"
+        np.testing.assert_array_equal(np.asarray(b_st[PB + p]), st, f"walker {p}")
+        np.testing.assert_array_equal(np.asarray(b_of[PB + p]), of, f"walker {p}")
 
 
 def test_ab_engine_paths():
