@@ -818,3 +818,14 @@ def peek_batched(
         slab, en, stage, off, ver, vlen,
         is_remove=ones, want_out=ones, max_walk=max_walk, collect=remove,
     )
+
+
+# Eager per-op dispatch is orders of magnitude slower than compiled code on
+# this host; the public sequential entry points are jitted (the engine's
+# sequential mode additionally inlines them under its own jit, where these
+# wrappers are free).  The batched kernels are always called under the
+# engine's jit and need no wrappers.
+put_first = jax.jit(put_first)
+put = jax.jit(put)
+branch = jax.jit(branch, static_argnames=("max_walk",))
+peek = jax.jit(peek, static_argnames=("max_walk", "remove"))

@@ -74,3 +74,19 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """HBM usage of the first device (empty dict when the backend doesn't
+    report) — sizing aid for lane-count / slab-shape capacity planning."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+    return {
+        k: int(v)
+        for k, v in stats.items()
+        if isinstance(v, (int, float)) and "bytes" in k
+    }
