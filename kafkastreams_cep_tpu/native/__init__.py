@@ -30,7 +30,8 @@ from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("native")
 
-_SRC = Path(__file__).parent / "src" / "ingest.cpp"
+_SRC_DIR = Path(__file__).parent / "src"
+_SRC = _SRC_DIR / "ingest.cpp"  # ABI anchor; all .cpp files are compiled
 _ABI_VERSION = 1
 
 _lib: Optional[ctypes.CDLL] = None
@@ -48,12 +49,15 @@ def _cache_dir() -> Path:
 
 def _build() -> Optional[Path]:
     try:
-        src = _SRC.read_bytes()
+        sources = sorted(_SRC_DIR.glob("*.cpp"))
+        if not sources:
+            raise OSError(f"no .cpp sources under {_SRC_DIR}")
+        blob = b"\0".join(s.read_bytes() for s in sources)
     except OSError as e:
         # e.g. a wheel built without the .cpp in package data.
         logger.warning("native source unavailable (%s); using NumPy fallbacks", e)
         return None
-    tag = hashlib.sha256(src).hexdigest()[:16]
+    tag = hashlib.sha256(blob).hexdigest()[:16]
     out = _cache_dir() / f"libcepingest-{tag}.so"
     if out.exists():
         return out
@@ -63,7 +67,7 @@ def _build() -> Optional[Path]:
         tmp_out = Path(tmp) / out.name
         cmd = [
             "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-            str(_SRC), "-o", str(tmp_out),
+            *[str(s) for s in sources], "-o", str(tmp_out),
         ]
         try:
             subprocess.run(
@@ -134,6 +138,10 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, i64, ctypes.c_char_p, i32, ctypes.c_char_p,
         f64p, ctypes.c_char_p, i64, u8p, i64, i64p,
     ]
+    lib.cep_journal_append.restype = i32
+    lib.cep_journal_append.argtypes = [ctypes.c_char_p, u8p, i64, i32]
+    lib.cep_journal_scan.restype = i64
+    lib.cep_journal_scan.argtypes = [u8p, i64, i64p, i64, i64p]
     _lib = lib
     return _lib
 

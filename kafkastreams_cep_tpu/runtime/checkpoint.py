@@ -70,11 +70,17 @@ def _unflatten_state(template: EngineState, arrays: Dict[str, np.ndarray]) -> En
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def save_checkpoint(processor: CEPProcessor, path: str) -> None:
-    """Snapshot a processor's full state to ``path`` (a single file)."""
+def save_checkpoint(
+    processor: CEPProcessor, path: str, extra: Optional[Dict[str, Any]] = None
+) -> None:
+    """Snapshot a processor's full state to ``path`` (a single file).
+
+    ``extra`` rides along in the header for the caller's own bookkeeping
+    (e.g. the supervisor's journal sequence number)."""
     arrays = _flatten_state(processor.state)
     header = {
         "format_version": FORMAT_VERSION,
+        "extra": dict(extra or {}),
         # Stage names only — the lookup-by-name restore contract.
         "stage_names": list(processor.batch.names),
         "state_names": list(processor.batch.matcher.tables.state_names),
@@ -114,15 +120,19 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
     return {"header": header, "arrays": arrays}
 
 
-def restore_processor(pattern, path: str) -> CEPProcessor:
+def restore_processor(
+    pattern, path: str, ckpt: Optional[Dict[str, Any]] = None
+) -> CEPProcessor:
     """Rebuild a processor from user code + a checkpoint.
 
     ``pattern`` is compiled fresh (predicates/folds come from code, exactly
     like ``ComputationStageSerDe`` rehydrating stages from the topology);
     the checkpoint supplies only state.  A topology whose stage names don't
-    match the checkpoint is refused.
+    match the checkpoint is refused.  Pass ``ckpt`` (a
+    :func:`load_checkpoint` result) to reuse an already-loaded file.
     """
-    ckpt = load_checkpoint(path)
+    if ckpt is None:
+        ckpt = load_checkpoint(path)
     header = ckpt["header"]
     config = EngineConfig(**header["config"])
     proc = CEPProcessor(

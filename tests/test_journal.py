@@ -1,0 +1,171 @@
+"""Durable journal: framing, torn-tail repair, native/Python interop, and
+process-crash resume through the supervisor."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu import native
+from kafkastreams_cep_tpu.native.journal import Journal
+from kafkastreams_cep_tpu.runtime.supervisor import Supervisor
+from kafkastreams_cep_tpu.runtime.processor import Record
+
+
+def _both_paths():
+    yield "numpy", False
+    if native.available():
+        yield "native", True
+
+
+def _with_path(use_native, fn):
+    saved = native._lib
+    try:
+        if not use_native:
+            native._lib = None
+        return fn()
+    finally:
+        native._lib = saved
+
+
+PAYLOADS = [b"alpha", b"", b"x" * 5000, pickle.dumps({"k": [1, 2, 3]})]
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_append_replay_round_trip(label, use_native, tmp_path):
+    j = Journal(str(tmp_path / "j.log"))
+    _with_path(use_native, lambda: [j.append(p) for p in PAYLOADS])
+    got = _with_path(use_native, lambda: list(j.replay()))
+    assert got == PAYLOADS
+
+
+@pytest.mark.parametrize("wr,rd", [(False, True), (True, False)])
+def test_native_python_interop(wr, rd, tmp_path):
+    if not native.available():
+        pytest.skip("native library unavailable")
+    j = Journal(str(tmp_path / "j.log"))
+    _with_path(wr, lambda: [j.append(p) for p in PAYLOADS])
+    got = _with_path(rd, lambda: list(j.replay()))
+    assert got == PAYLOADS
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_torn_tail_is_truncated(label, use_native, tmp_path):
+    path = tmp_path / "j.log"
+    j = Journal(str(path))
+    _with_path(use_native, lambda: [j.append(p) for p in PAYLOADS])
+    intact_size = path.stat().st_size
+    # Simulate a crash mid-append: a partial frame at the tail.
+    with open(path, "ab") as f:
+        f.write(b"\x31\x50\x45\x43\xff\xff")  # magic + garbage length
+    got = _with_path(use_native, lambda: list(j.replay()))
+    assert got == PAYLOADS
+    assert path.stat().st_size == intact_size  # repaired
+    # Appends after repair land on a clean boundary.
+    _with_path(use_native, lambda: j.append(b"after"))
+    assert _with_path(use_native, lambda: list(j.replay())) == PAYLOADS + [b"after"]
+
+
+@pytest.mark.parametrize("label,use_native", list(_both_paths()))
+def test_corrupt_middle_frame_stops_replay(label, use_native, tmp_path):
+    path = tmp_path / "j.log"
+    j = Journal(str(path))
+    _with_path(use_native, lambda: [j.append(b"one"), j.append(b"twoo")])
+    data = bytearray(path.read_bytes())
+    data[12] ^= 0xFF  # flip a payload byte of frame 1
+    path.write_bytes(bytes(data))
+    got = _with_path(use_native, lambda: list(j.replay(repair=False)))
+    assert got == []  # first frame corrupt -> nothing after it is trusted
+
+
+def test_truncate_and_missing_file(tmp_path):
+    j = Journal(str(tmp_path / "j.log"))
+    assert list(j.replay()) == []  # missing file is an empty journal
+    j.append(b"a")
+    j.truncate()
+    assert list(j.replay()) == []
+
+
+def test_resume_skips_frames_already_in_snapshot(tmp_path):
+    """A crash between snapshotting and journal truncation leaves the
+    journal holding frames the checkpoint already contains; resume must
+    skip them (sequence numbers), not double-ingest."""
+    ck = str(tmp_path / "state.ckpt")
+    jl = str(tmp_path / "records.jnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jl, checkpoint_every=100,
+    )
+    vals = np.random.default_rng(9).integers(0, 5, size=12)
+    for i in range(3):
+        sup.process(
+            [Record("k", int(v), 1000 + j, offset=None)
+             for j, v in enumerate(vals[i * 4:(i + 1) * 4])]
+        )
+    # Snapshot succeeds but the "crash" hits before truncate(): rebuild the
+    # journal file content as it was pre-checkpoint.
+    journal_bytes = open(jl, "rb").read()
+    sup.checkpoint()
+    with open(jl, "wb") as f:
+        f.write(journal_bytes)  # truncation "lost" in the crash
+    state_before = sup.processor.state
+
+    resumed = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jl, checkpoint_every=100,
+    )
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state_before),
+        jax.tree_util.tree_leaves(resumed.processor.state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_supervisor_resume_after_process_crash(tmp_path):
+    """Kill-and-resume: a fresh Supervisor.resume from the on-disk
+    checkpoint + journal must land in the crashed instance's exact state."""
+    ck = str(tmp_path / "state.ckpt")
+    jl = str(tmp_path / "records.jnl")
+
+    def records(lo, hi):
+        return [
+            Record("k", int(v), 1000 + i, offset=i)
+            for i, v in enumerate(
+                np.random.default_rng(5).integers(0, 5, size=hi), start=0
+            )
+        ][lo:hi]
+
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jl, checkpoint_every=2,
+    )
+    all_matches = []
+    for i in range(5):  # checkpoint after batches 2 and 4; journal holds 5th
+        all_matches.extend(sup.process(records(i * 4, (i + 1) * 4)))
+    state_before = sup.processor.state
+
+    # "Crash": drop the supervisor, resume from disk in a new instance.
+    resumed = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jl, checkpoint_every=2,
+    )
+    for a, b in zip(
+        __import__("jax").tree_util.tree_leaves(state_before),
+        __import__("jax").tree_util.tree_leaves(resumed.processor.state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Both continue identically on the next batch.
+    nxt = records(20, 24)
+    m1 = sup.process(list(nxt))
+    m2 = resumed.process(list(nxt))
+    assert [
+        (k, sorted((n, tuple(e.offset for e in evs)) for n, evs in s.as_map().items()))
+        for k, s in m1
+    ] == [
+        (k, sorted((n, tuple(e.offset for e in evs)) for n, evs in s.as_map().items()))
+        for k, s in m2
+    ]
