@@ -17,8 +17,11 @@ Prints ONE JSON line to stdout:
 
 Environment knobs: ``CEP_BENCH_K`` (lanes, default 4096), ``CEP_BENCH_T``
 (events/lane/scan, default 256), ``CEP_BENCH_REPS`` (timed scans, default
-3), ``CEP_BENCH_ORACLE_N`` (oracle-timed events, default 4000),
-``CEP_BENCH_STENCIL_N`` (strict-SEQ stencil events, default 1048576),
+2), ``CEP_BENCH_ORACLE_N`` (oracle-timed events, default 1000 — the
+oracle's unbounded state makes its per-event cost grow),
+``CEP_BENCH_STENCIL_N`` / ``CEP_BENCH_STENCIL_INNER`` (strict-SEQ stencil
+events and in-dispatch repeats), ``CEP_BENCH_EXTRAS`` /
+``CEP_BENCH_BUDGET_S`` / ``CEP_BENCH_{KLEENE,BANK,SHARD}_*`` (configs 2-4),
 ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
@@ -35,6 +38,23 @@ if os.environ.get("CEP_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["CEP_PLATFORM"])
 
 import jax
+
+# Persistent compilation cache: compiles through the device tunnel cost
+# 25-100s each; cached executables bring repeat runs down to seconds.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "CEP_BENCH_CACHE_DIR",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "cep_tpu_bench_cache",
+        ),
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -124,22 +144,35 @@ def bench_stencil(total_events, reps):
     m = StencilMatcher(pattern, K)
     rng = np.random.default_rng(7)
     events = make_batch(rng, K, T)
+    # Amortize inside ONE dispatch: per-dispatch latency through the device
+    # tunnel (~100ms) otherwise dominates and understates the device rate
+    # by an order of magnitude.
+    inner = max(int(os.environ.get("CEP_BENCH_STENCIL_INNER", "10")), 1)
+
+    @jax.jit
+    def many(state):
+        def body(s, _):
+            s2, out = m.scan(s, events)
+            return s2, jnp.sum(out.hit)
+        return jax.lax.scan(body, state, None, length=inner)
+
     t0 = time.perf_counter()
-    _, out = m.scan(m.init_state(), events)
-    jax.block_until_ready(out.hit)
-    log(f"stencil: compile+first scan {time.perf_counter() - t0:.1f}s")
+    _, hits = many(m.init_state())
+    jax.block_until_ready(hits)
+    log(f"stencil: compile+first run {time.perf_counter() - t0:.1f}s")
     best = float("inf")
     for i in range(reps):
         t0 = time.perf_counter()
-        _, out = m.scan(m.init_state(), events)
-        jax.block_until_ready(out.hit)
+        _, hits = many(m.init_state())
+        jax.block_until_ready(hits)
         best = min(best, time.perf_counter() - t0)
-    n_hits = int(jnp.sum(out.hit))
+    n_hits = int(hits[0])
+    total = K * T * inner
     log(
-        f"stencil (strict 3-stage SEQ, {K}x{T} events): "
-        f"{K * T / best / 1e6:.1f}M ev/s, {n_hits} matches"
+        f"stencil (strict 3-stage SEQ, {K}x{T} events x{inner} in-dispatch): "
+        f"{total / best / 1e6:.1f}M ev/s, {n_hits} matches/scan"
     )
-    return K * T / best
+    return total / best
 
 
 def bench_kleene(K, T, reps):
@@ -284,6 +317,7 @@ def bench_oracle(n_events):
     oracle = OracleNFA.from_pattern(stock_demo.stock_pattern())
     t0 = time.perf_counter()
     n_matches = 0
+    early_dt = None
     for i in range(n_events):
         n_matches += len(
             oracle.match(
@@ -293,9 +327,15 @@ def bench_oracle(n_events):
                 offset=i,
             )
         )
+        if i == 499:
+            early_dt = time.perf_counter() - t0
     dt = time.perf_counter() - t0
-    log(f"oracle: {n_events} events in {dt:.2f}s "
-        f"({n_events / dt / 1e3:.1f}K ev/s), {n_matches} matches")
+    early = f", first 500 at {500 / early_dt:.0f} ev/s" if early_dt else ""
+    log(
+        f"oracle: {n_events} events in {dt:.2f}s ({n_events / dt:.0f} ev/s"
+        f"{early}; unbounded state grows per event, like the reference), "
+        f"{n_matches} matches"
+    )
     return n_events / dt
 
 
@@ -303,8 +343,12 @@ def main():
     t_start = time.perf_counter()
     K = int(os.environ.get("CEP_BENCH_K", "4096"))
     T = int(os.environ.get("CEP_BENCH_T", "256"))
-    reps = int(os.environ.get("CEP_BENCH_REPS", "3"))
-    oracle_n = int(os.environ.get("CEP_BENCH_ORACLE_N", "4000"))
+    reps = int(os.environ.get("CEP_BENCH_REPS", "2"))
+    # The oracle is faithful to the reference's unbounded-state design, so
+    # its per-event cost GROWS on this match-dense trace (measured: 500
+    # events in ~1s, 2000 in ~120s cumulative); 1000 events keeps the
+    # comparison honest without dominating bench wall time.
+    oracle_n = int(os.environ.get("CEP_BENCH_ORACLE_N", "1000"))
 
     parity_gate()
     bench_stencil(int(os.environ.get("CEP_BENCH_STENCIL_N", "1048576")), reps)

@@ -56,8 +56,12 @@ class Journal:
         payload = bytes(payload)
         lib = _native._load()
         if lib is not None:
-            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload) \
-                if payload else (ctypes.c_uint8 * 1)()
+            # Zero-copy borrow: c_char_p points at the bytes object's
+            # buffer, which the C side only reads.
+            buf = ctypes.cast(
+                ctypes.c_char_p(payload or b"\0"),
+                ctypes.POINTER(ctypes.c_uint8),
+            )
             rc = lib.cep_journal_append(
                 self.path.encode(), buf, len(payload), 1 if self.sync else 0
             )
@@ -80,7 +84,9 @@ class Journal:
             max_frames = max(len(data) // _HEADER.size, 1)
             out = np.empty(2 * max_frames, dtype=np.int64)
             valid = ctypes.c_int64(0)
-            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+            buf = ctypes.cast(
+                ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8)
+            )
             n = lib.cep_journal_scan(
                 buf, len(data),
                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

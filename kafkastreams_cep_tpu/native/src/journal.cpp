@@ -61,6 +61,7 @@ uint32_t cep_crc32(const uint8_t* buf, int64_t len) {
 // must survive across the ctypes boundary).  Returns 0 on success.
 int32_t cep_journal_append(const char* path, const uint8_t* payload,
                            int64_t len, int32_t sync) {
+  if (len < 0 || len > (int64_t)0xFFFFFFFF) return -3;  // u32 frame length
   FILE* f = fopen(path, "ab");
   if (!f) return -1;
   uint32_t header[3] = {kMagic, (uint32_t)len, cep_crc32(payload, len)};

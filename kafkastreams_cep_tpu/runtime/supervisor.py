@@ -126,6 +126,7 @@ class Supervisor:
         journal_path: Optional[str] = None,
         journal_sync: bool = False,
         processor: Optional[CEPProcessor] = None,
+        _resuming: bool = False,
         **proc_kwargs,
     ):
         self._pattern = pattern
@@ -147,6 +148,23 @@ class Supervisor:
         self._disk_journal = (
             Journal(journal_path, sync=journal_sync) if journal_path else None
         )
+        if (
+            not _resuming
+            and self._disk_journal is not None
+            and os.path.exists(journal_path)
+            and os.path.getsize(journal_path) > 0
+        ):
+            # A fresh supervisor starting over an old journal: its frames
+            # belong to a previous incarnation's history and would be
+            # replayed into the wrong state by a later resume().  Starting
+            # fresh declares that history abandoned — truncate it loudly.
+            # (To continue the old history, use Supervisor.resume.)
+            logger.warning(
+                "journal %s holds frames from a previous run; truncating "
+                "(use Supervisor.resume to continue a crashed run's history)",
+                journal_path,
+            )
+            self._disk_journal.truncate()
         self._has_checkpoint = False
         self._batches_since_ckpt = 0
         # Monotone batch sequence number: stamped into journal frames and
@@ -157,6 +175,7 @@ class Supervisor:
         self.recoveries = 0
         self.checkpoints = 0
         self.checkpoint_failures = 0
+        self.journal_failures = 0
 
     @classmethod
     def resume(
@@ -192,6 +211,7 @@ class Supervisor:
             checkpoint_path=checkpoint_path,
             journal_path=journal_path,
             processor=proc,
+            _resuming=True,
             **kwargs,
         )
         sup._has_checkpoint = proc is not None
@@ -264,7 +284,20 @@ class Supervisor:
             # the match stream stay consistent — the reference's Kafka
             # commit boundary has the same at-least-once window
             # (README.md:108), without the dedup.
-            self._disk_journal.append(pickle.dumps((self._seq, records)))
+            #
+            # An append *failure* (disk full) must not raise here: state
+            # already advanced, and a caller retry would double-apply the
+            # batch.  Count it — the in-memory journal still covers
+            # device-failure recovery; only process-crash durability for
+            # this batch is degraded.
+            try:
+                self._disk_journal.append(pickle.dumps((self._seq, records)))
+            except Exception:
+                self.journal_failures += 1
+                logger.exception(
+                    "journal append failed; batch %d not crash-durable",
+                    self._seq,
+                )
         self._batches_since_ckpt += 1
         if self._batches_since_ckpt >= self.checkpoint_every:
             # A failed snapshot (disk full, ...) must not lose the batch's
@@ -315,4 +348,5 @@ class Supervisor:
         out["recoveries"] = self.recoveries
         out["checkpoints"] = self.checkpoints
         out["checkpoint_failures"] = self.checkpoint_failures
+        out["journal_failures"] = self.journal_failures
         return out

@@ -87,6 +87,25 @@ def test_truncate_and_missing_file(tmp_path):
     assert list(j.replay()) == []
 
 
+def test_fresh_supervisor_truncates_stale_journal(tmp_path):
+    """Starting over an old journal abandons its history (a later resume
+    must never replay a previous incarnation's frames into fresh state)."""
+    import os
+
+    jl = str(tmp_path / "j.jnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        journal_path=jl, checkpoint_path=str(tmp_path / "c.ckpt"),
+    )
+    sup.process([Record("k", 1, 1000, offset=0)])
+    assert os.path.getsize(jl) > 0
+    Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        journal_path=jl, checkpoint_path=str(tmp_path / "c2.ckpt"),
+    )
+    assert os.path.getsize(jl) == 0
+
+
 def test_resume_skips_frames_already_in_snapshot(tmp_path):
     """A crash between snapshotting and journal truncation leaves the
     journal holding frames the checkpoint already contains; resume must
