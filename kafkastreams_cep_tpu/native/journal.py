@@ -54,6 +54,20 @@ class Journal:
 
     def append(self, payload: bytes) -> None:
         payload = bytes(payload)
+        # Remember the last good boundary: a failed append may leave a torn
+        # frame that would make every LATER (successful) frame unreachable
+        # on replay — roll back to this size before reporting the failure.
+        try:
+            size0 = os.path.getsize(self.path)
+        except OSError:
+            size0 = 0
+        try:
+            self._append(payload)
+        except Exception:
+            self._rollback(size0)
+            raise
+
+    def _append(self, payload: bytes) -> None:
         lib = _native._load()
         if lib is not None:
             # Zero-copy borrow: c_char_p points at the bytes object's
@@ -74,6 +88,18 @@ class Journal:
             f.flush()
             if self.sync:
                 os.fsync(f.fileno())
+
+    def _rollback(self, size: int) -> None:
+        try:
+            if os.path.getsize(self.path) > size:
+                with open(self.path, "r+b") as f:
+                    f.truncate(size)
+        except OSError:
+            logger.exception(
+                "journal %s: rollback after failed append also failed; "
+                "later frames may be unreachable until replay repairs",
+                self.path,
+            )
 
     # -- reading ------------------------------------------------------------
 

@@ -148,23 +148,31 @@ class Supervisor:
         self._disk_journal = (
             Journal(journal_path, sync=journal_sync) if journal_path else None
         )
-        if (
-            not _resuming
-            and self._disk_journal is not None
-            and os.path.exists(journal_path)
-            and os.path.getsize(journal_path) > 0
-        ):
-            # A fresh supervisor starting over an old journal: its frames
-            # belong to a previous incarnation's history and would be
-            # replayed into the wrong state by a later resume().  Starting
-            # fresh declares that history abandoned — truncate it loudly.
-            # (To continue the old history, use Supervisor.resume.)
-            logger.warning(
-                "journal %s holds frames from a previous run; truncating "
-                "(use Supervisor.resume to continue a crashed run's history)",
-                journal_path,
-            )
-            self._disk_journal.truncate()
+        if not _resuming:
+            # A fresh supervisor starting over a previous incarnation's
+            # files: that history would otherwise leak into a later
+            # resume() — the old checkpoint (with its higher seq) would be
+            # restored and the new run's journal frames skipped.  Starting
+            # fresh declares the old history abandoned — remove both
+            # loudly.  (To continue it, use Supervisor.resume.)
+            if (
+                self._disk_journal is not None
+                and os.path.exists(journal_path)
+                and os.path.getsize(journal_path) > 0
+            ):
+                logger.warning(
+                    "journal %s holds frames from a previous run; truncating "
+                    "(use Supervisor.resume to continue that history)",
+                    journal_path,
+                )
+                self._disk_journal.truncate()
+            if os.path.exists(self.checkpoint_path):
+                logger.warning(
+                    "checkpoint %s belongs to a previous run; removing "
+                    "(use Supervisor.resume to continue that history)",
+                    self.checkpoint_path,
+                )
+                os.remove(self.checkpoint_path)
         self._has_checkpoint = False
         self._batches_since_ckpt = 0
         # Monotone batch sequence number: stamped into journal frames and

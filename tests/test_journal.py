@@ -106,6 +106,62 @@ def test_fresh_supervisor_truncates_stale_journal(tmp_path):
     assert os.path.getsize(jl) == 0
 
 
+def test_fresh_supervisor_removes_stale_checkpoint(tmp_path):
+    """Starting fresh must abandon the old checkpoint too — otherwise a
+    later resume() restores the previous incarnation's state and skips the
+    new run's journal frames (their seqs fall below the old snapshot's)."""
+    import os
+
+    ck = str(tmp_path / "c.ckpt")
+    jl = str(tmp_path / "j.jnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jl, checkpoint_every=1,
+    )
+    sup.process([Record("k", 1, 1000, offset=0)])
+    assert os.path.exists(ck)
+    fresh = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jl, checkpoint_every=100,
+    )
+    assert not os.path.exists(ck)
+    fresh.process([Record("k", 2, 2000, offset=0)])
+    resumed = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jl,
+    )
+    # The resumed instance carries the FRESH run's single batch.
+    assert resumed._seq == 1
+
+
+def test_failed_append_rolls_back_torn_frame(tmp_path, monkeypatch):
+    """An append that fails mid-write must not leave a torn frame that
+    orphans every later successful frame at replay time."""
+    import os
+
+    path = tmp_path / "j.log"
+    j = Journal(str(path), sync=True)
+    _with_path(False, lambda: j.append(b"good-1"))
+
+    real_fsync = os.fsync
+    calls = {"n": 0}
+
+    def flaky_fsync(fd):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(28, "No space left on device")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", flaky_fsync)
+    with pytest.raises(OSError):
+        _with_path(False, lambda: j.append(b"failed"))
+    monkeypatch.setattr(os, "fsync", real_fsync)
+
+    _with_path(False, lambda: j.append(b"good-2"))
+    got = _with_path(False, lambda: list(j.replay()))
+    assert got == [b"good-1", b"good-2"]
+
+
 def test_resume_skips_frames_already_in_snapshot(tmp_path):
     """A crash between snapshotting and journal truncation leaves the
     journal holding frames the checkpoint already contains; resume must
