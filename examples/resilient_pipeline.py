@@ -103,7 +103,7 @@ def main():
     for i, batch in enumerate(batches(rng, 10)):
         for name, sup in sups.items():
             for key, seq in sup.process(batch):
-                emitted.append((name, key, sorted(seq.as_map())))
+                emitted.append((name, key, sorted(seq.as_map().items())))
     print(f"phase 1: {len(emitted)} matches from 10 batches")
     for name, sup in sups.items():
         h = sup.health()
@@ -119,7 +119,7 @@ def main():
     for batch in batches(rng, 5, start=10):
         for name, sup in sups.items():
             for key, seq in sup.process(batch):
-                more.append((name, key, sorted(seq.as_map())))
+                more.append((name, key, sorted(seq.as_map().items())))
     print(f"phase 2 (post-recovery): {len(more)} further matches")
     for name, sup in sups.items():
         print(f"  {name}: recoveries={sup.recoveries}, "

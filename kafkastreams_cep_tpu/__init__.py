@@ -16,11 +16,18 @@ A ground-up re-design of the capabilities of ``vaquarkhan/kafkastreams-cep``
   (``engine.StencilMatcher``),
 * single-chip key batching and multi-chip mesh sharding
   (``parallel.BatchMatcher`` / ``parallel.ShardedMatcher``),
-* a host runtime with micro-batching, checkpoint/restore, and the stock
-  demo (``runtime.CEPProcessor``, ``runtime/checkpoint.py``,
-  ``examples/stock_demo.py``; reference: ``CEPProcessor.java``),
-* a benchmark harness (``bench.py``) and driver entries
-  (``__graft_entry__.py``).
+* a host runtime with micro-batching, checkpoint/restore, multi-query
+  banks, and the stock demo (``runtime.CEPProcessor``, ``runtime.CEPBank``,
+  ``runtime/checkpoint.py``, ``examples/stock_demo.py``;
+  reference: ``CEPProcessor.java``),
+* failure detection & recovery: health probes, auto-restore with
+  deterministic replay, and a durable CRC-framed record journal with
+  process-crash resume (``runtime.supervisor``, ``native/journal.py``,
+  ``examples/resilient_pipeline.py``),
+* native C++ host kernels behind ctypes with NumPy fallbacks — columnar
+  lane packing, JSON-lines parsing, journal IO (``native/``),
+* a benchmark harness (``bench.py``) covering the BASELINE.json configs
+  and driver entries (``__graft_entry__.py``).
 """
 
 from kafkastreams_cep_tpu.utils.events import Event, Sequence

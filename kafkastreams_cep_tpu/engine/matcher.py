@@ -562,40 +562,67 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             )
             slab = slab_mod.puts_batched(state.slab, ops, off)
 
-            # Branch walks, deepest-first within each run (unwind order) —
-            # separate so the common no-branch step early-exits the whole
-            # RH-walker phase after one condition check.
+            # Branch refcount walks commute (increments only, and pointer
+            # selection never reads refcounts), so the enabled ones can be
+            # compacted order-free from the [R, H] frame grid into R merged
+            # walker slots; the rare overflow (> R branches in one event)
+            # runs through the separate early-exiting phase.
             def rev(f):
                 return f[:, ::-1].reshape((RH,) + f.shape[2:])
 
+            br_en = rev(rec.br_en)
+            br_rank = jnp.cumsum(br_en.astype(i32)) - 1
+            in_primary = br_en & (br_rank < R)
+            ohc = in_primary[:, None] & (
+                br_rank[:, None] == jnp.arange(R, dtype=i32)[None, :]
+            )  # [RH, R]
+
+            def cmp_br(field, fill=0):
+                m = ohc.reshape((RH, R) + (1,) * (field.ndim - 1))
+                v = jnp.sum(jnp.where(m, field[:, None], 0), axis=0)
+                got = jnp.any(ohc, axis=0).reshape(
+                    (R,) + (1,) * (field.ndim - 1)
+                )
+                return jnp.where(got, v.astype(field.dtype), fill)
+
+            b_en = jnp.any(ohc, axis=0)
+            b_stage = cmp_br(rev(rec.br_prev))
+            b_off = cmp_br(prev_off_rep)
+            b_ver = cmp_br(rev(rec.br_ver))
+            b_vlen = cmp_br(rev(rec.br_vlen))
+
+            rest_en = br_en & (br_rank >= R)
             slab = slab_mod.branch_batched(
-                slab, rev(rec.br_en), rev(rec.br_prev), prev_off_rep,
+                slab, rest_en, rev(rec.br_prev), prev_off_rep,
                 rev(rec.br_ver), rev(rec.br_vlen), W,
             )
 
-            # Dead-run removals (NFA.java:102-103,117-123) and final-match
-            # extraction (NFA.java:111-115), merged into one lockstep pass.
+            # One merged lockstep pass: compacted branch walks (increment),
+            # dead-run removals (NFA.java:102-103,117-123), and final-match
+            # extraction (NFA.java:111-115).
             dead_en = rec.dead & (state.event_off >= 0)
-            w_en = jnp.concatenate([dead_en, final_en])
+            w_en = jnp.concatenate([b_en, dead_en, final_en])
             w_stage = jnp.concatenate(
-                [jnp.maximum(state.id_pos, 0), rec.surv_id]
+                [b_stage, jnp.maximum(state.id_pos, 0), rec.surv_id]
             )
             w_off = jnp.concatenate(
-                [state.event_off, jnp.broadcast_to(off, (R,))]
+                [b_off, state.event_off, jnp.broadcast_to(off, (R,))]
             )
-            w_ver = jnp.concatenate([state.ver, rec.surv_ver])
-            w_vlen = jnp.concatenate([state.vlen, rec.surv_vlen])
-            w_remove = jnp.ones((2 * R,), bool)
+            w_ver = jnp.concatenate([b_ver, state.ver, rec.surv_ver])
+            w_vlen = jnp.concatenate([b_vlen, state.vlen, rec.surv_vlen])
+            w_remove = jnp.concatenate(
+                [jnp.zeros((R,), bool), jnp.ones((2 * R,), bool)]
+            )
             w_out = jnp.concatenate(
-                [jnp.zeros((R,), bool), jnp.ones((R,), bool)]
+                [jnp.zeros((2 * R,), bool), jnp.ones((R,), bool)]
             )
             slab, w_out_stage, w_out_off, w_count = slab_mod.walks_batched(
                 slab, w_en, w_stage, w_off, w_ver, w_vlen,
                 w_remove, w_out, W,
             )
-            out_stage = w_out_stage[R:]
-            out_off = w_out_off[R:]
-            out_count = w_count[R:]
+            out_stage = w_out_stage[2 * R:]
+            out_off = w_out_off[2 * R:]
+            out_count = w_count[2 * R:]
 
         # --- Next queue: per run [survivor, branches deepest-first, re-seed],
         # flattened in queue order, compacted into R slots (overflow counted).

@@ -94,6 +94,8 @@ class Journal:
             if os.path.getsize(self.path) > size:
                 with open(self.path, "r+b") as f:
                     f.truncate(size)
+        except FileNotFoundError:
+            return  # nothing was written — nothing to roll back
         except OSError:
             logger.exception(
                 "journal %s: rollback after failed append also failed; "

@@ -184,6 +184,11 @@ class Supervisor:
         self.checkpoints = 0
         self.checkpoint_failures = 0
         self.journal_failures = 0
+        # After a failed append the on-disk journal is no longer a complete
+        # history — appending later batches would leave a seq gap that a
+        # resume would replay straight through into a wrong state.  Suspend
+        # journaling until the next checkpoint re-establishes a clean base.
+        self._journal_suspended = False
 
     @classmethod
     def resume(
@@ -231,6 +236,17 @@ class Supervisor:
                 if seq <= base_seq:
                     skipped += 1  # already inside the snapshot
                     continue
+                if seq != sup._seq + 1:
+                    # Defense in depth: a seq gap means the journal is not
+                    # a complete history (it should be impossible — a
+                    # failed append suspends journaling).  Replaying past
+                    # the gap would build a state that never saw the
+                    # missing batches; stop at the last contiguous frame.
+                    logger.error(
+                        "journal seq gap (%d -> %d); stopping replay at "
+                        "the last contiguous frame", sup._seq, seq,
+                    )
+                    break
                 sup.processor.process(batch)  # matches already emitted
                 sup._journal.append(batch)
                 sup._batches_since_ckpt += 1
@@ -254,6 +270,7 @@ class Supervisor:
         self._journal.clear()
         if self._disk_journal is not None:
             self._disk_journal.truncate()
+            self._journal_suspended = False  # clean base re-established
         self._batches_since_ckpt = 0
         self.checkpoints += 1
 
@@ -295,17 +312,24 @@ class Supervisor:
             #
             # An append *failure* (disk full) must not raise here: state
             # already advanced, and a caller retry would double-apply the
-            # batch.  Count it — the in-memory journal still covers
-            # device-failure recovery; only process-crash durability for
-            # this batch is degraded.
-            try:
-                self._disk_journal.append(pickle.dumps((self._seq, records)))
-            except Exception:
-                self.journal_failures += 1
-                logger.exception(
-                    "journal append failed; batch %d not crash-durable",
-                    self._seq,
-                )
+            # batch.  Count it and SUSPEND journaling until the next
+            # checkpoint — later frames after a missing seq would otherwise
+            # replay into a state that never saw this batch.  The in-memory
+            # journal still covers device-failure recovery; process-crash
+            # durability is degraded until the next snapshot.
+            if not self._journal_suspended:
+                try:
+                    self._disk_journal.append(
+                        pickle.dumps((self._seq, records))
+                    )
+                except Exception:
+                    self.journal_failures += 1
+                    self._journal_suspended = True
+                    logger.exception(
+                        "journal append failed; journaling suspended until "
+                        "the next checkpoint (batch %d+ not crash-durable)",
+                        self._seq,
+                    )
         self._batches_since_ckpt += 1
         if self._batches_since_ckpt >= self.checkpoint_every:
             # A failed snapshot (disk full, ...) must not lose the batch's
