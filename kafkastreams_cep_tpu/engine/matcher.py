@@ -117,6 +117,16 @@ class EngineConfig:
     slab_preds: int = 8  # MP — predecessor pointers per buffer entry
     dewey_depth: int = 12  # D — fixed Dewey width (overflow counted)
     max_walk: int = 16  # W — buffer walk bound = max match length
+    # Width of the compacted walker pool the jnp walk pass runs over.
+    # Typically only ~1-2 of the step's 3R+ candidate walkers are enabled;
+    # the pass drains enabled walkers in queue-order batches of this width.
+    # 1 (default) = exactly the reference's sequential per-walker order.
+    # Wider batches run walkers of a batch in lockstep — near-sequential and
+    # faster when many walkers fire, but when two removal walkers meet at
+    # one entry in the same hop, prune/delete attribution can deviate from
+    # sequential (a refs==0 entry may survive with a stale pointer).  The
+    # fused Pallas kernel path is always sequential-exact regardless.
+    walker_budget: int = 1
     enforce_windows: bool = False  # deviation: functional within() pruning
     # Apply slab ops one run at a time (the reference's literal op order)
     # instead of the batched per-step passes.  The batched path reproduces
@@ -219,6 +229,19 @@ def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
         state.slab.missing,
         state.slab.trunc,
     )
+
+
+class StepPhases(NamedTuple):
+    """The step's per-lane phase functions, exposed so batched callers can
+    run the walk pass over the full lane batch (the fused Pallas kernel
+    operates on ``[K]``-batched slabs and cannot live under ``vmap``)."""
+
+    eval_chain: Any
+    build_walkers: Any
+    finish: Any
+    out_base: int
+    out_rows: int
+    max_walk: int
 
 
 class _ChainRecord(NamedTuple):
@@ -457,13 +480,15 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             stk(br_agg), final_agg, has_succ, dead, ovf,
         )
 
-    def step(state: EngineState, ev: EventBatch) -> Tuple[EngineState, StepOutput]:
-        i32 = jnp.int32
-        key, value, ts, off = ev.key, ev.value, jnp.asarray(ev.ts, i32), jnp.asarray(ev.off, i32)
-        valid = _as_bool(ev.valid)
+    RH = R * H
 
-        preds = jax.vmap(lambda a: eval_preds(key, value, ts, a))(state.agg)  # [R, P]
-        rec: _ChainRecord = jax.vmap(
+    def eval_chain(state: EngineState, ev: EventBatch) -> _ChainRecord:
+        """Predicate evaluation + every run's unrolled chain (per lane)."""
+        i32 = jnp.int32
+        key, value = ev.key, ev.value
+        ts, off = jnp.asarray(ev.ts, i32), jnp.asarray(ev.off, i32)
+        preds = jax.vmap(lambda a: eval_preds(key, value, ts, a))(state.agg)
+        return jax.vmap(
             chain_one,
             in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None, None),
         )(
@@ -471,6 +496,60 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             state.event_off, state.start_ts, state.branching, state.agg,
             preds, key, value, ts, off,
         )
+
+    def build_walkers(state: EngineState, rec: _ChainRecord, ev: EventBatch):
+        """Consuming puts + the step's walker-candidate queue (per lane).
+
+        Queue layout (reference op order): branch frames deepest-first per
+        run ([RH]), dead-run removals ([R]), final extractions ([R]) —
+        ``out_base = RH + R``, ``out_rows = R``.
+        """
+        i32 = jnp.int32
+        off = jnp.asarray(ev.off, i32)
+        valid = _as_bool(ev.valid)
+        final_en = rec.surv_alive & rec.surv_final & valid
+
+        prev_off_rep = jnp.repeat(state.event_off, H)
+        ops = slab_mod.PutOps(
+            en=rec.put_en.reshape(RH),
+            first=rec.put_prev.reshape(RH) < 0,
+            cur_stage=rec.put_cur.reshape(RH),
+            prev_stage=rec.put_prev.reshape(RH),
+            prev_off=prev_off_rep,
+            ver=rec.put_ver.reshape(RH, D),
+            vlen=rec.put_vlen.reshape(RH),
+        )
+        slab = slab_mod.puts_batched(state.slab, ops, off)
+
+        def rev(f):
+            return f[:, ::-1].reshape((RH,) + f.shape[2:])
+
+        dead_en = rec.dead & (state.event_off >= 0)
+        w_en = jnp.concatenate([rev(rec.br_en), dead_en, final_en])
+        w_stage = jnp.concatenate(
+            [rev(rec.br_prev), jnp.maximum(state.id_pos, 0), rec.surv_id]
+        )
+        w_off = jnp.concatenate(
+            [prev_off_rep, state.event_off, jnp.broadcast_to(off, (R,))]
+        )
+        w_ver = jnp.concatenate([rev(rec.br_ver), state.ver, rec.surv_ver])
+        w_vlen = jnp.concatenate(
+            [rev(rec.br_vlen), state.vlen, rec.surv_vlen]
+        )
+        w_remove = jnp.concatenate(
+            [jnp.zeros((RH,), bool), jnp.ones((2 * R,), bool)]
+        )
+        w_out = jnp.concatenate(
+            [jnp.zeros((RH + R,), bool), jnp.ones((R,), bool)]
+        )
+        return slab, (w_en, w_stage, w_off, w_ver, w_vlen, w_remove, w_out)
+
+    def step(state: EngineState, ev: EventBatch) -> Tuple[EngineState, StepOutput]:
+        i32 = jnp.int32
+        off = jnp.asarray(ev.off, i32)
+        valid = _as_bool(ev.valid)
+
+        rec = eval_chain(state, ev)
 
         # --- Shared-buffer mutations, in the reference's exact op order:
         # per run (queue order): consuming puts frame-by-frame, branch walks
@@ -546,83 +625,32 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
                 ),
             )
         else:
-            RH = R * H
-            # Consuming puts, flattened run-major / frame-ascending — the
-            # reference's op order.  A put's predecessor offset is its run's
-            # pointer event, identical across frames.
-            prev_off_rep = jnp.repeat(state.event_off, H)
-            ops = slab_mod.PutOps(
-                en=rec.put_en.reshape(RH),
-                first=rec.put_prev.reshape(RH) < 0,
-                cur_stage=rec.put_cur.reshape(RH),
-                prev_stage=rec.put_prev.reshape(RH),
-                prev_off=prev_off_rep,
-                ver=rec.put_ver.reshape(RH, D),
-                vlen=rec.put_vlen.reshape(RH),
-            )
-            slab = slab_mod.puts_batched(state.slab, ops, off)
-
-            # Branch refcount walks commute (increments only, and pointer
-            # selection never reads refcounts), so the enabled ones can be
-            # compacted order-free from the [R, H] frame grid into R merged
-            # walker slots; the rare overflow (> R branches in one event)
-            # runs through the separate early-exiting phase.
-            def rev(f):
-                return f[:, ::-1].reshape((RH,) + f.shape[2:])
-
-            br_en = rev(rec.br_en)
-            br_rank = jnp.cumsum(br_en.astype(i32)) - 1
-            in_primary = br_en & (br_rank < R)
-            ohc = in_primary[:, None] & (
-                br_rank[:, None] == jnp.arange(R, dtype=i32)[None, :]
-            )  # [RH, R]
-
-            def cmp_br(field, fill=0):
-                m = ohc.reshape((RH, R) + (1,) * (field.ndim - 1))
-                v = jnp.sum(jnp.where(m, field[:, None], 0), axis=0)
-                got = jnp.any(ohc, axis=0).reshape(
-                    (R,) + (1,) * (field.ndim - 1)
-                )
-                return jnp.where(got, v.astype(field.dtype), fill)
-
-            b_en = jnp.any(ohc, axis=0)
-            b_stage = cmp_br(rev(rec.br_prev))
-            b_off = cmp_br(prev_off_rep)
-            b_ver = cmp_br(rev(rec.br_ver))
-            b_vlen = cmp_br(rev(rec.br_vlen))
-
-            rest_en = br_en & (br_rank >= R)
-            slab = slab_mod.branch_batched(
-                slab, rest_en, rev(rec.br_prev), prev_off_rep,
-                rev(rec.br_ver), rev(rec.br_vlen), W,
-            )
-
-            # One merged lockstep pass: compacted branch walks (increment),
+            # One walk pass serves every walker of the step — branch
+            # refcount walks (deepest-first per run, NFA.java:231-246),
             # dead-run removals (NFA.java:102-103,117-123), and final-match
-            # extraction (NFA.java:111-115).
-            dead_en = rec.dead & (state.event_off >= 0)
-            w_en = jnp.concatenate([b_en, dead_en, final_en])
-            w_stage = jnp.concatenate(
-                [b_stage, jnp.maximum(state.id_pos, 0), rec.surv_id]
+            # extraction (NFA.java:111-115) — compacted in queue-order rank
+            # into a small pool (PROFILE_r04.md: carrying all 3R+ slots
+            # through every hop was ~90% of the step).
+            slab, wk = build_walkers(state, rec, ev)
+            slab, out_stage, out_off, out_count = slab_mod.walks_compacted(
+                slab, *wk, W,
+                budget=cfg.walker_budget, out_base=RH + R, out_rows=R,
             )
-            w_off = jnp.concatenate(
-                [b_off, state.event_off, jnp.broadcast_to(off, (R,))]
-            )
-            w_ver = jnp.concatenate([b_ver, state.ver, rec.surv_ver])
-            w_vlen = jnp.concatenate([b_vlen, state.vlen, rec.surv_vlen])
-            w_remove = jnp.concatenate(
-                [jnp.zeros((R,), bool), jnp.ones((2 * R,), bool)]
-            )
-            w_out = jnp.concatenate(
-                [jnp.zeros((2 * R,), bool), jnp.ones((R,), bool)]
-            )
-            slab, w_out_stage, w_out_off, w_count = slab_mod.walks_batched(
-                slab, w_en, w_stage, w_off, w_ver, w_vlen,
-                w_remove, w_out, W,
-            )
-            out_stage = w_out_stage[2 * R:]
-            out_off = w_out_off[2 * R:]
-            out_count = w_count[2 * R:]
+
+        return finish(state, ev, rec, slab, out_stage, out_off, out_count)
+
+    def finish(
+        state: EngineState,
+        ev: EventBatch,
+        rec: _ChainRecord,
+        slab,
+        out_stage,
+        out_off,
+        out_count,
+    ) -> Tuple[EngineState, StepOutput]:
+        """Queue compaction + padding masking (per lane)."""
+        i32 = jnp.int32
+        valid = _as_bool(ev.valid)
 
         # --- Next queue: per run [survivor, branches deepest-first, re-seed],
         # flattened in queue order, compacted into R slots (overflow counted).
@@ -736,7 +764,15 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             ver_overflows=jnp.zeros((), i32),
         )
 
-    return step, init_state
+    phases = StepPhases(
+        eval_chain=eval_chain,
+        build_walkers=build_walkers,
+        finish=finish,
+        out_base=RH + R,
+        out_rows=R,
+        max_walk=W,
+    )
+    return step, init_state, phases
 
 
 class TPUMatcher:
@@ -763,9 +799,10 @@ class TPUMatcher:
             self.tables.num_stages, self.tables.names,
             self.tables.max_hops, self.config,
         )
-        step, init_state = _build_step(self.tables, self.config)
+        step, init_state, phases = _build_step(self.tables, self.config)
         self._step_fn = step
         self._init_fn = init_state
+        self._phases = phases
         self.step = jax.jit(step)
         self.scan = jax.jit(self._scan)
 

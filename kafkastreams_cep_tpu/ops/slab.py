@@ -411,6 +411,12 @@ def walks_batched(
             (e[None, :] == e[:, None]) & later & arm[None, :], axis=1
         )
 
+        # Row extraction stays a one-hot matmul over the full packed slab:
+        # a batched gather (``jnp.take(ptrs, e, axis=0)``) was measured 4x
+        # SLOWER end-to-end (41s vs 9.5s headline scan) — TPU dynamic
+        # gathers in a while-loop body neither fuse nor vectorize.  The
+        # einsum's full-slab re-read per hop is the remaining HBM cost the
+        # Pallas walk kernel eliminates (state resident in VMEM).
         rows = _rows(ptrs, ham)
         pv, ps, po, pl = (
             rows[..., :D],
@@ -794,6 +800,115 @@ def branch_batched(
     return slab._replace(
         trunc=slab.trunc + jnp.sum(active.astype(i32))
     )
+
+
+def walks_compacted(
+    slab: SlabState,
+    en,
+    stage,
+    off,
+    ver,
+    vlen,
+    is_remove,
+    want_out,
+    max_walk: int,
+    budget: int,
+    out_base: int,
+    out_rows: int,
+):
+    """The step's walk pass over a *small* compacted walker pool.
+
+    The engine presents P candidate walkers per step (every branch frame,
+    every dead run, every potential final extraction) but typically only a
+    handful are enabled.  Carrying all P slots through every walk hop made
+    the walk pass ~90% of the headline step (PROFILE_r04.md): per-hop HBM
+    traffic is proportional to the pool width.  This wrapper compacts the
+    *enabled* walkers, in queue-order rank, into ``budget`` slots and runs
+    :func:`walks_batched` over batches of that width until all are served.
+
+    Ordering: batches are processed in ascending rank order; each batch's
+    deletes/prunes and pointer compaction complete before the next batch
+    starts.  With ``budget=1`` (the engine default) every walker runs alone
+    — exactly the reference's sequential per-walker order.  With wider
+    budgets, walkers *within* a batch run under :func:`walks_batched`'s
+    lockstep protocol, which deviates from sequential when two removal
+    walkers meet at one entry in the same hop (prune/delete attribution
+    goes to the queue-last walker only; a refs==0 entry can survive with a
+    stale pointer) — see ``EngineConfig.walker_budget``.
+
+    Only rows ``[out_base, out_base + out_rows)`` of the candidate list can
+    request output (the engine's final-extraction segment); their hops are
+    scattered back to ``out_rows``-indexed rows so the engine never
+    materializes a [P, W] output.
+
+    Returns ``(slab, out_stage [out_rows, W], out_off [out_rows, W],
+    count [out_rows])``.
+    """
+    i32 = jnp.int32
+    W = max_walk
+    P = jnp.asarray(stage).shape[0]
+    B = budget
+    en = jnp.asarray(en)
+    stage = jnp.asarray(stage, i32)
+    off = jnp.asarray(off, i32)
+    ver = jnp.asarray(ver, i32)
+    vlen = jnp.asarray(vlen, i32)
+    is_remove = jnp.asarray(is_remove)
+    want_out = jnp.asarray(want_out)
+
+    rank = jnp.cumsum(en.astype(i32)) - 1  # queue-order rank of enabled
+    n = jnp.sum(en.astype(i32))
+    bidx = jnp.arange(B, dtype=i32)
+
+    def cond(carry):
+        return carry[1] < n
+
+    def body(carry):
+        slab, start, out_stage, out_off, count = carry
+        ohc = (en & (rank >= start) & (rank < start + B))[:, None] & (
+            (rank - start)[:, None] == bidx[None, :]
+        )  # [P, B] — at most one True per row and per column
+
+        def gather(field, fill=0):
+            m = ohc.reshape((P, B) + (1,) * (field.ndim - 1))
+            v = jnp.sum(jnp.where(m, field[:, None], 0), axis=0)
+            if field.dtype == jnp.bool_:
+                return jnp.any(m & field.reshape((P, 1) + field.shape[1:]), axis=0)
+            got = jnp.any(ohc, axis=0).reshape((B,) + (1,) * (field.ndim - 1))
+            return jnp.where(got, v.astype(field.dtype), fill)
+
+        b_en = jnp.any(ohc, axis=0)
+        slab, b_out_stage, b_out_off, b_count = walks_batched(
+            slab,
+            b_en,
+            gather(stage),
+            gather(off),
+            gather(ver),
+            gather(vlen),
+            gather(is_remove),
+            gather(want_out),
+            W,
+        )
+        # Scatter served output walkers back to their final-segment rows.
+        oho = ohc[out_base:out_base + out_rows]  # [out_rows, B]
+        got = jnp.any(oho, axis=1)
+        upd_st = jnp.sum(jnp.where(oho[:, :, None], b_out_stage[None], 0), axis=1)
+        upd_of = jnp.sum(jnp.where(oho[:, :, None], b_out_off[None], 0), axis=1)
+        upd_ct = jnp.sum(jnp.where(oho, b_count[None], 0), axis=1)
+        out_stage = jnp.where(got[:, None], upd_st.astype(i32), out_stage)
+        out_off = jnp.where(got[:, None], upd_of.astype(i32), out_off)
+        count = jnp.where(got, upd_ct.astype(i32), count)
+        return slab, start + B, out_stage, out_off, count
+
+    init = (
+        slab,
+        jnp.zeros((), i32),
+        jnp.full((out_rows, W), -1, i32),
+        jnp.full((out_rows, W), -1, i32),
+        jnp.zeros((out_rows,), i32),
+    )
+    slab, _, out_stage, out_off, count = jax.lax.while_loop(cond, body, init)
+    return slab, out_stage, out_off, count
 
 
 def peek_batched(

@@ -1,0 +1,355 @@
+"""Fused Pallas walk-pass kernel — the step's buffer walks in VMEM.
+
+The walk pass (branch refcount walks ``KVSharedVersionedBuffer.java:99-110``,
+dead-run removals ``:147-171``, final-match extraction ``NFA.java:111-115``)
+is ~90% of the headline step in the jnp engine (PROFILE_r04.md): every hop of
+its while-loop re-reads the packed pointer slab from HBM.  This kernel keeps
+each lane-block's slab resident in VMEM across *all* hops of *all* walkers of
+the step, reducing per-step slab HBM traffic to one read + one write.
+
+Execution model
+---------------
+One grid program owns ``L`` lanes (lane axis last, width 128).  Walker
+candidates arrive as a ``[PW]``-row queue per lane with a precomputed
+queue-order ``rank``; the kernel loops ``b = 0..max(n_enabled)`` batches, and
+in each batch every lane serves its rank-``b`` walker — **one walker per lane
+at a time**, so per-lane buffer mutation order is *exactly* the reference's
+sequential queue order (no lockstep merge argument needed), while the vector
+unit parallelizes across the 128 lanes of the block.
+
+Pointer prunes are physical (`TimedKeyValue.removePredecessor` shift-left),
+applied immediately — again exactly the sequential semantics, affordable
+because the arrays live in VMEM.
+
+Semantics are differentially tested against the jnp pass
+(``ops/slab.py: walks_compacted``) and, through it, against the sequential
+per-op path and the host oracle (``tests/test_walk_kernel.py``,
+``tests/test_engine_fuzz.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kafkastreams_cep_tpu.ops.slab import SlabState
+
+LANE_BLOCK = 128
+
+
+def _kernel(
+    # inputs (lane-last blocks)
+    stage, off, refs, npreds, pstage, poff, pvlen, pver, missing, trunc,
+    en, wstage, woff, wvlen, wver, wrem, wout, rank, nen,
+    # outputs
+    o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
+    o_missing, o_trunc, o_ostage, o_ooff, o_count,
+    *, W: int, out_base: int, out_rows: int,
+):
+    E, MP, L = pstage.shape
+    D = pver.shape[2]
+    PW = en.shape[0]
+    OR = out_rows
+    i32 = jnp.int32
+
+    # Working state lives in the output refs (VMEM) for the whole pass.
+    o_stage[:] = stage[:]
+    o_off[:] = off[:]
+    o_refs[:] = refs[:]
+    o_npreds[:] = npreds[:]
+    o_pstage[:] = pstage[:]
+    o_poff[:] = poff[:]
+    o_pvlen[:] = pvlen[:]
+    o_pver[:] = pver[:]
+    o_missing[:] = missing[:]
+    o_trunc[:] = trunc[:]
+    o_ostage[:] = jnp.full((OR, W, L), -1, i32)
+    o_ooff[:] = jnp.full((OR, W, L), -1, i32)
+    o_count[:] = jnp.zeros((OR, L), i32)
+
+    iota_pw = jax.lax.broadcasted_iota(i32, (PW, L), 0)
+    iota_mp = jax.lax.broadcasted_iota(i32, (MP, L), 0)
+    iota_mp3 = jax.lax.broadcasted_iota(i32, (E, MP, L), 1)
+    iota_d3 = jax.lax.broadcasted_iota(i32, (MP, D, L), 1)
+    iota_or3 = jax.lax.broadcasted_iota(i32, (OR, W, L), 0)
+    iota_w3 = jax.lax.broadcasted_iota(i32, (OR, W, L), 1)
+    iota_or2 = jax.lax.broadcasted_iota(i32, (OR, L), 0)
+
+    max_n = jnp.max(nen[0, :])
+
+    def batch_body(b):
+        selm = rank[:] == b  # [PW, L] — at most one True per lane
+        act0 = jnp.any(selm, axis=0, keepdims=True)  # [1, L]
+
+        def pick(f):  # [PW, L] -> [1, L]
+            return jnp.sum(jnp.where(selm, f, 0), axis=0, keepdims=True)
+
+        ws = pick(wstage[:])
+        wo = pick(woff[:])
+        wvl = pick(wvlen[:])
+        wrm = jnp.any(selm & (wrem[:] != 0), axis=0, keepdims=True)
+        wot = jnp.any(selm & (wout[:] != 0), axis=0, keepdims=True)
+        srow = pick(iota_pw - out_base)
+        qv0 = jnp.sum(
+            jnp.where(selm[:, None, :], wver[:], 0), axis=0
+        )  # [D, L]
+
+        def hop_cond(c):
+            h, active = c[0], c[1]
+            return (h < W) & jnp.any(active != 0)
+
+        def hop_body(c):
+            h, active_i, cs, co, qv, ql, cnt = c
+            active = active_i != 0
+            hit = (o_stage[:] == cs) & (o_off[:] == co)  # [E, L]
+            found = jnp.any(hit, axis=0, keepdims=True)  # [1, L]
+            o_missing[:] = o_missing[:] + jnp.where(active & ~found, 1, 0)
+            active = active & found
+            ham = hit & active  # [E, L] — <=1 True per lane (unique keys)
+
+            refs_e = jnp.sum(jnp.where(ham, o_refs[:], 0), axis=0, keepdims=True)
+            # Remove-walkers decrement (floored at zero,
+            # TimedKeyValue.java:59-61); branch walkers increment.
+            newref = jnp.where(wrm, jnp.maximum(refs_e - 1, 0), refs_e + 1)
+            o_refs[:] = jnp.where(ham, newref, o_refs[:])
+            np_e = jnp.sum(jnp.where(ham, o_npreds[:], 0), axis=0, keepdims=True)
+            dele = active & wrm & (newref == 0) & (np_e <= 1)
+            dmask = ham & dele
+            o_stage[:] = jnp.where(dmask, -1, o_stage[:])
+            o_off[:] = jnp.where(dmask, -1, o_off[:])
+
+            # Emit the hop for extraction walkers.
+            emit = active & wot
+            mw = (iota_or3 == srow[None]) & (iota_w3 == cnt[None]) & emit[None]
+            o_ostage[:] = jnp.where(mw, cs[None], o_ostage[:])
+            o_ooff[:] = jnp.where(mw, co[None], o_ooff[:])
+            cnt = cnt + jnp.where(emit, 1, 0)
+
+            # The hit entry's pointer rows (masked reduce over E — the slab
+            # stays in VMEM, so this is pure vector work).
+            ham3 = ham[:, None, :]
+            ps_ = jnp.sum(jnp.where(ham3, o_pstage[:], 0), axis=0)  # [MP, L]
+            po_ = jnp.sum(jnp.where(ham3, o_poff[:], 0), axis=0)
+            pl_ = jnp.sum(jnp.where(ham3, o_pvlen[:], 0), axis=0)
+            pv_ = jnp.sum(
+                jnp.where(ham[:, None, None, :], o_pver[:], 0), axis=0
+            )  # [MP, D, L]
+            live = iota_mp < np_e  # [MP, L]
+
+            # dewey_ops.is_compatible vectorized over the MP pointers
+            # (DeweyVersion.java:62-82).  Prefix checks count violations in
+            # i32 — Mosaic cannot select on i1 vectors.
+            neq = (qv[None] != pv_).astype(jnp.int32)  # [MP, D, L]
+            plm = pl_[:, None, :]
+            prefix_full = (
+                jnp.sum(neq * (iota_d3 < plm).astype(jnp.int32), axis=1) == 0
+            )
+            prefix_butl = (
+                jnp.sum(neq * (iota_d3 < plm - 1).astype(jnp.int32), axis=1)
+                == 0
+            )
+            last_q = jnp.sum(jnp.where(iota_d3 == plm - 1, qv[None], 0), axis=1)
+            last_p = jnp.sum(jnp.where(iota_d3 == plm - 1, pv_, 0), axis=1)
+            ok = ((ql > pl_) & prefix_full) | (
+                (ql == pl_) & prefix_butl & (last_q >= last_p)
+            )
+            ok = ok & live  # [MP, L]
+            # First compatible pointer = masked min over slot index (Mosaic
+            # argmax supports only f32; this is the spike-validated idiom).
+            j = jnp.min(jnp.where(ok, iota_mp, MP), axis=0, keepdims=True)
+            selany = j < MP  # [1, L]
+            ohj = iota_mp == j  # [MP, L]
+
+            # Physical prune of the traversed pointer when refs hit zero
+            # (KVSharedVersionedBuffer.java:164-168): shift-left at
+            # (entry, slots >= j), last slot keeping its own value
+            # (TimedKeyValue.removePredecessor).
+            prune = selany & active & wrm & (newref == 0)
+
+            @pl.when(jnp.any(prune))
+            def _():
+                pm = ham3 & (iota_mp3 >= j[None]) & prune[None]  # [E, MP, L]
+
+                def shift(ref, m):
+                    f = ref[:]
+                    nxt = jnp.concatenate([f[:, 1:], f[:, -1:]], axis=1)
+                    ref[:] = jnp.where(m, nxt, f)
+
+                shift(o_pstage, pm)
+                shift(o_poff, pm)
+                shift(o_pvlen, pm)
+                shift(o_pver, pm[:, :, None, :])
+                o_npreds[:] = o_npreds[:] - jnp.where(ham & prune, 1, 0)
+
+            nxt_s = jnp.sum(jnp.where(ohj, ps_, 0), axis=0, keepdims=True)
+            nxt_o = jnp.sum(jnp.where(ohj, po_, 0), axis=0, keepdims=True)
+            nxt_l = jnp.sum(jnp.where(ohj, pl_, 0), axis=0, keepdims=True)
+            nxt_v = jnp.sum(jnp.where(ohj[:, None, :], pv_, 0), axis=0)  # [D, L]
+
+            nactive = active & selany & (nxt_s >= 0)
+            # Extraction walkers get W emitting hops; cut beyond that is a
+            # counted truncation (matches ops/slab.py walks_batched).
+            budget_out = emit & (cnt >= W)
+            o_trunc[:] = o_trunc[:] + jnp.where(budget_out & nactive, 1, 0)
+            active = nactive & ~budget_out
+            cs = jnp.where(active, nxt_s, cs)
+            co = jnp.where(active, nxt_o, co)
+            ql = jnp.where(active, nxt_l, ql)
+            qv = jnp.where(active, nxt_v, qv)
+            return h + 1, active.astype(jnp.int32), cs, co, qv, ql, cnt
+
+        zero_l = jnp.zeros((1, L), i32)
+        h, active_i, cs, co, qv, ql, cnt = jax.lax.while_loop(
+            hop_cond, hop_body,
+            (jnp.zeros((), i32), act0.astype(i32), ws, wo, qv0, wvl, zero_l),
+        )
+        # Walkers still active at the hop bound were truncated.
+        o_trunc[:] = o_trunc[:] + active_i
+        # Served extraction walkers record their hop count.
+        cm = (iota_or2 == srow) & wot
+        o_count[:] = jnp.where(cm, cnt, o_count[:])
+        return b + 1
+
+    jax.lax.while_loop(
+        lambda b: b < max_n, batch_body, jnp.zeros((), i32)
+    )
+
+
+def _to_lane_last(x):
+    """[K, ...] -> [..., K]."""
+    return jnp.moveaxis(x, 0, -1)
+
+
+def _from_lane_last(x):
+    return jnp.moveaxis(x, -1, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_walk", "out_base", "out_rows", "interpret"),
+)
+def walk_pass_kernel(
+    slab: SlabState,
+    en,
+    stage,
+    off,
+    ver,
+    vlen,
+    is_remove,
+    want_out,
+    max_walk: int,
+    out_base: int,
+    out_rows: int,
+    interpret: bool = False,
+) -> Tuple[SlabState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The step's walk pass for a ``[K]``-batched slab via the fused kernel.
+
+    Same contract as ``jax.vmap`` of ``ops/slab.py: walks_compacted`` —
+    ``K`` must be a multiple of 128.  Returns
+    ``(slab, out_stage [K, out_rows, W], out_off, count [K, out_rows])``.
+    """
+    i32 = jnp.int32
+    K, E = slab.stage.shape
+    MP = slab.pstage.shape[2]
+    D = slab.pver.shape[3]
+    PW = en.shape[1]
+    W = max_walk
+    OR = out_rows
+    if K % LANE_BLOCK:
+        raise ValueError(f"K={K} not a multiple of {LANE_BLOCK}")
+
+    en_i = en.astype(i32)
+    rank = jnp.where(en, jnp.cumsum(en_i, axis=1) - 1, -1)
+    nen = jnp.sum(en_i, axis=1)[None, :]  # [1, K] after transpose below
+
+    ins = [
+        _to_lane_last(slab.stage),
+        _to_lane_last(slab.off),
+        _to_lane_last(slab.refs),
+        _to_lane_last(slab.npreds),
+        _to_lane_last(slab.pstage),
+        _to_lane_last(slab.poff),
+        _to_lane_last(slab.pvlen),
+        _to_lane_last(slab.pver),
+        # Per-lane scalar counters arrive as [K]; kernel blocks want [1, L].
+        slab.missing[None, :],
+        slab.trunc[None, :],
+        _to_lane_last(en_i),
+        _to_lane_last(jnp.asarray(stage, i32)),
+        _to_lane_last(jnp.asarray(off, i32)),
+        _to_lane_last(jnp.asarray(vlen, i32)),
+        _to_lane_last(jnp.asarray(ver, i32)),
+        _to_lane_last(jnp.asarray(is_remove).astype(i32)),
+        _to_lane_last(jnp.asarray(want_out).astype(i32)),
+        _to_lane_last(rank),
+        nen,
+    ]
+
+    L = LANE_BLOCK
+    grid = (K // L,)
+
+    def bspec(shape):
+        nd = len(shape)
+        return pl.BlockSpec(
+            shape[:-1] + (L,),
+            (lambda i, nd=nd: (0,) * (nd - 1) + (i,)),
+            memory_space=pltpu.VMEM,
+        )
+
+    in_specs = [bspec(tuple(x.shape[:-1]) + (L,)) for x in ins]
+    out_shapes = [
+        jax.ShapeDtypeStruct((E, K), i32),  # stage
+        jax.ShapeDtypeStruct((E, K), i32),  # off
+        jax.ShapeDtypeStruct((E, K), i32),  # refs
+        jax.ShapeDtypeStruct((E, K), i32),  # npreds
+        jax.ShapeDtypeStruct((E, MP, K), i32),  # pstage
+        jax.ShapeDtypeStruct((E, MP, K), i32),  # poff
+        jax.ShapeDtypeStruct((E, MP, K), i32),  # pvlen
+        jax.ShapeDtypeStruct((E, MP, D, K), i32),  # pver
+        jax.ShapeDtypeStruct((1, K), i32),  # missing
+        jax.ShapeDtypeStruct((1, K), i32),  # trunc
+        jax.ShapeDtypeStruct((OR, W, K), i32),  # out_stage
+        jax.ShapeDtypeStruct((OR, W, K), i32),  # out_off
+        jax.ShapeDtypeStruct((OR, K), i32),  # count
+    ]
+    out_specs = [bspec(tuple(s.shape[:-1]) + (L,)) for s in out_shapes]
+
+    outs = pl.pallas_call(
+        functools.partial(
+            _kernel, W=W, out_base=out_base, out_rows=out_rows
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(*ins)
+
+    (n_stage, n_off, n_refs, n_npreds, n_pstage, n_poff, n_pvlen, n_pver,
+     n_missing, n_trunc, o_stage, o_off, o_count) = outs
+    new_slab = slab._replace(
+        stage=_from_lane_last(n_stage),
+        off=_from_lane_last(n_off),
+        refs=_from_lane_last(n_refs),
+        npreds=_from_lane_last(n_npreds),
+        pstage=_from_lane_last(n_pstage),
+        poff=_from_lane_last(n_poff),
+        pvlen=_from_lane_last(n_pvlen),
+        pver=_from_lane_last(n_pver),
+        missing=n_missing[0],
+        trunc=n_trunc[0],
+    )
+    return (
+        new_slab,
+        _from_lane_last(o_stage),
+        _from_lane_last(o_off),
+        _from_lane_last(o_count),
+    )

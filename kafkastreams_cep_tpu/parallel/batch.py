@@ -8,6 +8,7 @@ compiled dispatch.  This is the unit the mesh layer shards.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -22,6 +23,9 @@ from kafkastreams_cep_tpu.engine.matcher import (
     TPUMatcher,
     counter_values,
 )
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.batch")
 
 
 def broadcast_state(state: EngineState, num_lanes: int) -> EngineState:
@@ -52,6 +56,81 @@ def lane_scan(step_one):
     return scan
 
 
+def kernel_lane_step(matcher: TPUMatcher, interpret: bool = False):
+    """A ``[K]``-batched step whose walk pass runs the fused Pallas kernel.
+
+    The chain and puts phases stay vmapped jnp; the walk pass — ~90% of the
+    step in the all-jnp engine (PROFILE_r04.md) — runs once over the whole
+    lane batch with each block's slab resident in VMEM
+    (``ops/walk_kernel.py``).  Semantically identical to
+    ``lane_step(matcher._step_fn)`` (same phase order, same sequential
+    queue-order walk semantics); differentially tested in
+    ``tests/test_walk_kernel.py`` and the engine A/B test.
+    """
+    from kafkastreams_cep_tpu.ops.walk_kernel import walk_pass_kernel
+
+    ph = matcher._phases
+
+    def step(state: EngineState, ev: EventBatch):
+        rec = jax.vmap(ph.eval_chain)(state, ev)
+        slab, wk = jax.vmap(ph.build_walkers)(state, rec, ev)
+        slab, out_stage, out_off, out_count = walk_pass_kernel(
+            slab, *wk,
+            max_walk=ph.max_walk, out_base=ph.out_base,
+            out_rows=ph.out_rows, interpret=interpret,
+        )
+        return jax.vmap(ph.finish)(
+            state, ev, rec, slab, out_stage, out_off, out_count
+        )
+
+    return step
+
+
+def kernel_lane_scan(step):
+    """Scan a kernel-backed batched step over the time axis of ``[K, T]``
+    events (time-major under the hood; the public layout is unchanged)."""
+
+    def scan(state: EngineState, events: EventBatch):
+        ev_t = jax.tree_util.tree_map(
+            lambda x: jnp.swapaxes(x, 0, 1), events
+        )
+        state, outs = jax.lax.scan(step, state, ev_t)
+        return state, jax.tree_util.tree_map(
+            lambda x: jnp.swapaxes(x, 0, 1), outs
+        )
+
+    return scan
+
+
+def _select_walk_kernel(config: EngineConfig, num_lanes: int):
+    """Decide (use_kernel, interpret) for this batch shape.
+
+    ``CEP_WALK_KERNEL``: ``auto`` (default — kernel on TPU backends when the
+    lane count allows), ``0`` (never), ``1`` (force compiled), ``interpret``
+    (force interpreter mode — CPU-testable).
+    """
+    from kafkastreams_cep_tpu.ops.walk_kernel import LANE_BLOCK
+
+    mode = os.environ.get("CEP_WALK_KERNEL", "auto")
+    feasible = (
+        not config.sequential_slab and num_lanes % LANE_BLOCK == 0
+    )
+    if not feasible and mode in ("1", "interpret"):
+        logger.warning(
+            "CEP_WALK_KERNEL=%s requested but infeasible for this matcher "
+            "(num_lanes=%d %% %d != 0 or sequential_slab) — falling back "
+            "to the jnp walk pass",
+            mode, num_lanes, LANE_BLOCK,
+        )
+    if mode == "0" or not feasible:
+        return False, False
+    if mode == "interpret":
+        return True, True
+    if mode == "1":
+        return True, False
+    return jax.default_backend() == "tpu", False
+
+
 class BatchMatcher:
     """``K`` independent per-key matchers stepped as one array program.
 
@@ -69,8 +148,20 @@ class BatchMatcher:
     ):
         self.matcher = TPUMatcher(pattern, config)
         self.num_lanes = int(num_lanes)
-        self._step_fn = lane_step(self.matcher._step_fn)
-        self._scan_fn = lane_scan(self.matcher._step_fn)
+        use_kernel, interpret = _select_walk_kernel(
+            self.matcher.config, self.num_lanes
+        )
+        self.uses_walk_kernel = use_kernel
+        if use_kernel:
+            logger.info(
+                "batch matcher: fused walk kernel enabled (%d lanes%s)",
+                self.num_lanes, ", interpret" if interpret else "",
+            )
+            self._step_fn = kernel_lane_step(self.matcher, interpret)
+            self._scan_fn = kernel_lane_scan(self._step_fn)
+        else:
+            self._step_fn = lane_step(self.matcher._step_fn)
+            self._scan_fn = lane_scan(self.matcher._step_fn)
         self.step = jax.jit(self._step_fn)
         self.scan = jax.jit(self._scan_fn)
 

@@ -1,0 +1,129 @@
+"""Fused Pallas walk kernel vs the sequential slab ops it replaces.
+
+Ground truth is the per-op sequential path — ``slab.branch`` for increment
+walkers and ``slab.peek(remove=True)`` for removal/extraction walkers,
+applied one walker at a time in queue order per lane (the reference's
+order, ``NFA.java:102-123``).  The kernel runs in interpreter mode on CPU
+(the suite's platform); the real-chip path is exercised by the benchmarks
+and the engine A/B test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu.ops import dewey_ops
+from kafkastreams_cep_tpu.ops import slab as slab_mod
+from kafkastreams_cep_tpu.ops.walk_kernel import LANE_BLOCK, walk_pass_kernel
+
+from test_slab_batched import assert_slab_equal, seed_slab
+
+E, MP, D, W = 16, 4, 6, 8
+OUT_BASE, OUT_ROWS = 4, 4  # candidate rows [4, 8) may emit
+PW = OUT_BASE + OUT_ROWS
+
+
+def random_walkers(rng):
+    """One lane's candidate walker set in the engine's layout: increment
+    walkers first, then remove walkers, the final ``OUT_ROWS`` extracting."""
+    en = rng.random(PW) < 0.5
+    stage = rng.integers(0, 4, size=PW).astype(np.int32)
+    off = rng.integers(0, 5, size=PW).astype(np.int32)
+    vers, vlens = [], []
+    for _ in range(PW):
+        comps = tuple(rng.integers(1, 3, size=rng.integers(1, 4)))
+        v, l = dewey_ops.make(comps, D)
+        vers.append(v)
+        vlens.append(l)
+    is_remove = np.arange(PW) >= 2  # rows [0,2): branch; [2,PW): remove
+    want_out = np.arange(PW) >= OUT_BASE
+    return dict(
+        en=en, stage=stage, off=off,
+        ver=np.stack(vers).astype(np.int32),
+        vlen=np.asarray(vlens, np.int32),
+        is_remove=is_remove, want_out=want_out,
+    )
+
+
+def sequential_lane(slab, wk):
+    """Queue-order per-walker ground truth for one lane."""
+    out_stage = np.full((OUT_ROWS, W), -1, np.int32)
+    out_off = np.full((OUT_ROWS, W), -1, np.int32)
+    count = np.zeros((OUT_ROWS,), np.int32)
+    for p in range(PW):
+        if not wk["en"][p]:
+            continue
+        if wk["is_remove"][p]:
+            slab, st, of, cnt = slab_mod.peek(
+                slab, int(wk["stage"][p]), int(wk["off"][p]),
+                jnp.asarray(wk["ver"][p]), jnp.asarray(wk["vlen"][p]),
+                W, remove=True, enable=True,
+            )
+            if wk["want_out"][p]:
+                r = p - OUT_BASE
+                out_stage[r] = np.asarray(st)
+                out_off[r] = np.asarray(of)
+                count[r] = int(cnt)
+        else:
+            slab = slab_mod.branch(
+                slab, int(wk["stage"][p]), int(wk["off"][p]),
+                jnp.asarray(wk["ver"][p]), jnp.asarray(wk["vlen"][p]),
+                W, enable=True,
+            )
+    return slab, out_stage, out_off, count
+
+
+def batch_lanes(lanes, field):
+    return jnp.asarray(np.stack([l[field] for l in lanes]))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_kernel_matches_sequential(seed):
+    rng = np.random.default_rng(400 + seed)
+    K = LANE_BLOCK
+    # A handful of distinct lane slabs tiled over the block (the kernel is
+    # elementwise over lanes; distinct-per-lane content catches cross-lane
+    # mixups, full-K distinctness only costs test time).
+    n_distinct = 8
+    slabs, wksets, seq = [], [], []
+    for i in range(n_distinct):
+        s = seed_slab(rng)
+        wk = random_walkers(rng)
+        slabs.append(s)
+        wksets.append(wk)
+        seq.append(sequential_lane(s, wk))
+    reps = K // n_distinct
+    slab_K = jax.tree_util.tree_map(
+        lambda *xs: jnp.asarray(
+            np.tile(np.stack([np.asarray(x) for x in xs]), (reps,) + (1,) * xs[0].ndim)
+        ),
+        *slabs,
+    )
+    wk_K = {f: jnp.tile(batch_lanes(wksets, f), (reps,) + (1,) * (batch_lanes(wksets, f).ndim - 1)) for f in wksets[0]}
+
+    new_slab, out_stage, out_off, count = walk_pass_kernel(
+        slab_K, wk_K["en"], wk_K["stage"], wk_K["off"], wk_K["ver"],
+        wk_K["vlen"], wk_K["is_remove"], wk_K["want_out"],
+        max_walk=W, out_base=OUT_BASE, out_rows=OUT_ROWS, interpret=True,
+    )
+
+    for i in range(n_distinct):
+        exp_slab, exp_st, exp_of, exp_ct = seq[i]
+        for rep in (0, reps - 1):
+            lane = rep * n_distinct + i
+            got = jax.tree_util.tree_map(lambda x: x[lane], new_slab)
+            # Sequential pads counters differently only in untouched fields.
+            assert_slab_equal(exp_slab, got, f"seed={seed} lane={lane}")
+            np.testing.assert_array_equal(
+                np.asarray(out_stage[lane]), exp_st,
+                err_msg=f"seed={seed} lane={lane} out_stage",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out_off[lane]), exp_of,
+                err_msg=f"seed={seed} lane={lane} out_off",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(count[lane]), exp_ct,
+                err_msg=f"seed={seed} lane={lane} count",
+            )
