@@ -49,6 +49,8 @@ def _kernel(
     # outputs
     o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
     o_missing, o_trunc, o_ostage, o_ooff, o_count,
+    # scratch
+    st_stage, st_off,
     *, W: int, out_base: int, out_rows: int,
 ):
     E, MP, L = pstage.shape
@@ -77,7 +79,7 @@ def _kernel(
     iota_mp3 = jax.lax.broadcasted_iota(i32, (E, MP, L), 1)
     iota_d3 = jax.lax.broadcasted_iota(i32, (MP, D, L), 1)
     iota_or3 = jax.lax.broadcasted_iota(i32, (OR, W, L), 0)
-    iota_w3 = jax.lax.broadcasted_iota(i32, (OR, W, L), 1)
+    iota_w2 = jax.lax.broadcasted_iota(i32, (W, L), 0)
     iota_or2 = jax.lax.broadcasted_iota(i32, (OR, L), 0)
 
     max_n = jnp.max(nen[0, :])
@@ -89,6 +91,8 @@ def _kernel(
         def pick(f):  # [PW, L] -> [1, L]
             return jnp.sum(jnp.where(selm, f, 0), axis=0, keepdims=True)
 
+        st_stage[:] = jnp.full((W, L), -1, i32)
+        st_off[:] = jnp.full((W, L), -1, i32)
         ws = pick(wstage[:])
         wo = pick(woff[:])
         wvl = pick(wvlen[:])
@@ -123,11 +127,13 @@ def _kernel(
             o_stage[:] = jnp.where(dmask, -1, o_stage[:])
             o_off[:] = jnp.where(dmask, -1, o_off[:])
 
-            # Emit the hop for extraction walkers.
+            # Emit the hop for extraction walkers into the per-batch [W, L]
+            # staging buffer (scattering straight into the [OR, W, L] output
+            # every hop costs OR/1 times the traffic).
             emit = active & wot
-            mw = (iota_or3 == srow[None]) & (iota_w3 == cnt[None]) & emit[None]
-            o_ostage[:] = jnp.where(mw, cs[None], o_ostage[:])
-            o_ooff[:] = jnp.where(mw, co[None], o_ooff[:])
+            mw = (iota_w2 == cnt) & emit
+            st_stage[:] = jnp.where(mw, cs, st_stage[:])
+            st_off[:] = jnp.where(mw, co, st_off[:])
             cnt = cnt + jnp.where(emit, 1, 0)
 
             # The hit entry's pointer rows (masked reduce over E — the slab
@@ -204,13 +210,18 @@ def _kernel(
             return h + 1, active.astype(jnp.int32), cs, co, qv, ql, cnt
 
         zero_l = jnp.zeros((1, L), i32)
+        # Early exit matters: the average walk ends well before the W-hop
+        # bound (a fixed-trip fori_loop measured ~2x slower end-to-end).
         h, active_i, cs, co, qv, ql, cnt = jax.lax.while_loop(
             hop_cond, hop_body,
             (jnp.zeros((), i32), act0.astype(i32), ws, wo, qv0, wvl, zero_l),
         )
         # Walkers still active at the hop bound were truncated.
         o_trunc[:] = o_trunc[:] + active_i
-        # Served extraction walkers record their hop count.
+        # Served extraction walkers scatter their staged hops + hop count.
+        mo = (iota_or3 == srow[None]) & wot[None]
+        o_ostage[:] = jnp.where(mo, st_stage[:][None], o_ostage[:])
+        o_ooff[:] = jnp.where(mo, st_off[:][None], o_ooff[:])
         cm = (iota_or2 == srow) & wot
         o_count[:] = jnp.where(cm, cnt, o_count[:])
         return b + 1
@@ -265,29 +276,35 @@ def walk_pass_kernel(
 
     en_i = en.astype(i32)
     rank = jnp.where(en, jnp.cumsum(en_i, axis=1) - 1, -1)
-    nen = jnp.sum(en_i, axis=1)[None, :]  # [1, K] after transpose below
+
+    tin = _to_lane_last
+    tout = _from_lane_last
+    row = lambda x: x[None, :]
+    unrow = lambda x: x[0]
+
+    nen = jnp.sum(en_i, axis=1)  # [K]
 
     ins = [
-        _to_lane_last(slab.stage),
-        _to_lane_last(slab.off),
-        _to_lane_last(slab.refs),
-        _to_lane_last(slab.npreds),
-        _to_lane_last(slab.pstage),
-        _to_lane_last(slab.poff),
-        _to_lane_last(slab.pvlen),
-        _to_lane_last(slab.pver),
+        tin(slab.stage),
+        tin(slab.off),
+        tin(slab.refs),
+        tin(slab.npreds),
+        tin(slab.pstage),
+        tin(slab.poff),
+        tin(slab.pvlen),
+        tin(slab.pver),
         # Per-lane scalar counters arrive as [K]; kernel blocks want [1, L].
-        slab.missing[None, :],
-        slab.trunc[None, :],
-        _to_lane_last(en_i),
-        _to_lane_last(jnp.asarray(stage, i32)),
-        _to_lane_last(jnp.asarray(off, i32)),
-        _to_lane_last(jnp.asarray(vlen, i32)),
-        _to_lane_last(jnp.asarray(ver, i32)),
-        _to_lane_last(jnp.asarray(is_remove).astype(i32)),
-        _to_lane_last(jnp.asarray(want_out).astype(i32)),
-        _to_lane_last(rank),
-        nen,
+        row(slab.missing),
+        row(slab.trunc),
+        tin(en_i),
+        tin(jnp.asarray(stage, i32)),
+        tin(jnp.asarray(off, i32)),
+        tin(jnp.asarray(vlen, i32)),
+        tin(jnp.asarray(ver, i32)),
+        tin(jnp.asarray(is_remove).astype(i32)),
+        tin(jnp.asarray(want_out).astype(i32)),
+        tin(rank),
+        row(nen),
     ]
 
     L = LANE_BLOCK
@@ -330,26 +347,30 @@ def walk_pass_kernel(
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
+        scratch_shapes=[
+            pltpu.VMEM((W, LANE_BLOCK), jnp.int32),
+            pltpu.VMEM((W, LANE_BLOCK), jnp.int32),
+        ],
         interpret=interpret,
     )(*ins)
 
     (n_stage, n_off, n_refs, n_npreds, n_pstage, n_poff, n_pvlen, n_pver,
      n_missing, n_trunc, o_stage, o_off, o_count) = outs
     new_slab = slab._replace(
-        stage=_from_lane_last(n_stage),
-        off=_from_lane_last(n_off),
-        refs=_from_lane_last(n_refs),
-        npreds=_from_lane_last(n_npreds),
-        pstage=_from_lane_last(n_pstage),
-        poff=_from_lane_last(n_poff),
-        pvlen=_from_lane_last(n_pvlen),
-        pver=_from_lane_last(n_pver),
-        missing=n_missing[0],
-        trunc=n_trunc[0],
+        stage=tout(n_stage),
+        off=tout(n_off),
+        refs=tout(n_refs),
+        npreds=tout(n_npreds),
+        pstage=tout(n_pstage),
+        poff=tout(n_poff),
+        pvlen=tout(n_pvlen),
+        pver=tout(n_pver),
+        missing=unrow(n_missing),
+        trunc=unrow(n_trunc),
     )
     return (
         new_slab,
-        _from_lane_last(o_stage),
-        _from_lane_last(o_off),
-        _from_lane_last(o_count),
+        tout(o_stage),
+        tout(o_off),
+        tout(o_count),
     )

@@ -74,6 +74,9 @@ def kernel_lane_step(matcher: TPUMatcher, interpret: bool = False):
     def step(state: EngineState, ev: EventBatch):
         rec = jax.vmap(ph.eval_chain)(state, ev)
         slab, wk = jax.vmap(ph.build_walkers)(state, rec, ev)
+        # (Lane-load sorting was tried here and measured net-negative: in
+        # load-sorted blocks every batch runs the full hop bound, erasing
+        # the batch-count win, and the permutation gathers add traffic.)
         slab, out_stage, out_off, out_count = walk_pass_kernel(
             slab, *wk,
             max_walk=ph.max_walk, out_base=ph.out_base,
