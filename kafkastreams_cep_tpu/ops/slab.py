@@ -327,6 +327,72 @@ def live_entries(slab: SlabState) -> jnp.ndarray:
     return jnp.sum(slab.stage >= 0)
 
 
+def mark_sweep(slab: SlabState, run_stage, run_off, depth: int) -> SlabState:
+    """Free every entry unreachable from live run state — the deferred
+    compaction scan of SURVEY §7 step 4.
+
+    The reference never needs this: its refcount GC
+    (``KVSharedVersionedBuffer.java:147-171``) runs over unbounded walks.
+    This engine's walks are bounded by ``max_walk``, so a truncated removal
+    walk strands its untraversed tail with elevated refcounts (counted in
+    ``trunc``) and the slab fills over long streams.  The sweep is
+    *observably equivalent* to the reference's state: every future buffer
+    operation starts from live run state — consuming puts reference a run's
+    pointer event, branch/removal/extraction walks start at a run's pointer
+    event or the current event — and walks take at most ``max_walk`` hops,
+    so an entry not reachable within ``depth >= max_walk`` pointer hops of
+    any live run can never be read or written again.  Freeing it changes no
+    future output and no counter.
+
+    ``run_off`` is the ``[N]`` array of the live runs' pointer-event
+    offsets (``off < 0`` rows ignored); ``run_stage`` is accepted for
+    signature symmetry but roots are keyed by offset alone — buffer
+    operations may start at any *stage* carrying a run's pointer offset
+    (e.g. a branch walk starts at the branching frame's predecessor stage,
+    a chained put references the same offset under the put frame's stage).
+    Marking follows ALL pointers (not version-filtered) — conservative
+    over every possible future walk version.  Vmappable over a leading
+    lane axis.
+    """
+    del run_stage  # roots are offset-keyed; see docstring
+    E, MP = slab.pstage.shape
+    run_off = jnp.asarray(run_off, jnp.int32)
+
+    # Roots: every entry at any live run's pointer-event offset.
+    root_hit = (slab.off[:, None] == run_off[None, :]) & (
+        run_off[None, :] >= 0
+    )  # [E, N]
+    marked = jnp.any(root_hit, axis=1) & (slab.stage >= 0)
+
+    # Adjacency: entry e reaches e' when any live pointer of e keys
+    # (stage, off)[e'].  Reduced over MP up front — marking ignores which
+    # pointer hit, and [E, E] is MP-times smaller than the [E, MP, E]
+    # grid a naive formulation would hold live across the loop.
+    valid_ptr = (
+        jnp.arange(MP, dtype=jnp.int32)[None, :] < slab.npreds[:, None]
+    ) & (slab.pstage >= 0)  # [E, MP]
+    adj = jnp.any(
+        (slab.pstage[:, :, None] == slab.stage[None, None, :])
+        & (slab.poff[:, :, None] == slab.off[None, None, :])
+        & valid_ptr[:, :, None],
+        axis=1,
+    )  # [E, E']
+
+    def body(_, m):
+        reach = jnp.any(adj & m[:, None], axis=0)  # [E']
+        return m | (reach & (slab.stage >= 0))
+
+    marked = jax.lax.fori_loop(0, depth, body, marked)
+
+    free = ~marked
+    return slab._replace(
+        stage=jnp.where(free, -1, slab.stage),
+        off=jnp.where(free, -1, slab.off),
+        refs=jnp.where(free, 0, slab.refs),
+        npreds=jnp.where(free, 0, slab.npreds),
+    )
+
+
 def walks_batched(
     slab: SlabState,
     en,

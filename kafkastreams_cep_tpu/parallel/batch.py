@@ -8,6 +8,7 @@ compiled dispatch.  This is the unit the mesh layer shards.
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Any, Dict, Optional, Tuple
 
@@ -174,6 +175,30 @@ class BatchMatcher:
 
     def init_state(self) -> EngineState:
         return broadcast_state(self.matcher.init_state(), self.num_lanes)
+
+    def sweep(self, state: EngineState) -> EngineState:
+        """Free slab entries unreachable from live run state (the deferred
+        compaction scan, SURVEY §7 step 4) — see ``ops/slab.py:mark_sweep``
+        for the observably-equivalent argument.  Call between batches on
+        long streams; ``CEPProcessor(gc_interval=N)`` does so automatically.
+        """
+        return self._sweep_jit(state)
+
+    @functools.cached_property
+    def _sweep_jit(self):
+        from kafkastreams_cep_tpu.ops import slab as slab_mod
+
+        depth = self.matcher.config.max_walk
+
+        @jax.jit
+        def run(state: EngineState) -> EngineState:
+            run_off = jnp.where(state.alive, state.event_off, -1)
+            slab = jax.vmap(
+                lambda s, ro: slab_mod.mark_sweep(s, None, ro, depth)
+            )(state.slab, run_off)
+            return state._replace(slab=slab)
+
+        return run
 
     def counters(self, state: EngineState) -> Dict[str, int]:
         """Aggregate overflow/drop counters summed over all lanes."""

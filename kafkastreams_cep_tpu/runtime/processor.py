@@ -111,10 +111,16 @@ class CEPProcessor:
         epoch: Optional[int] = None,
         gc_events: bool = True,
         dedup: bool = True,
+        gc_interval: int = 0,
     ):
         self.batch = BatchMatcher(pattern, num_lanes, config)
         self.topic = topic
         self.num_lanes = int(num_lanes)
+        # Slab mark-sweep every N batches (0 = off).  Long streams strand
+        # walk-bound-truncated paths in the slab (counted in ``trunc``);
+        # the sweep frees entries no future buffer op can reach, holding
+        # occupancy bounded at fixed slab_entries.
+        self.gc_interval = int(gc_interval)
         self.state = self.batch.init_state()
         self.epoch = epoch  # None = rebase to the first record's timestamp
         self.gc_events = gc_events
@@ -336,6 +342,8 @@ class CEPProcessor:
 
         with self.metrics.timed("device_seconds"):
             self.state, out = self.batch.scan(self.state, events)
+            if self.gc_interval and (self.metrics.batches + 1) % self.gc_interval == 0:
+                self.state = self.batch.sweep(self.state)
             jax.block_until_ready(out.count)
         with self.metrics.timed("decode_seconds"):
             matches = self._decode(out, rank_of)
