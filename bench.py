@@ -537,7 +537,7 @@ def main():
     # extra is skipped once the wall budget is spent — compiles through the
     # device tunnel are slow and the headline JSON must always be printed.
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
-        budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "420"))
+        budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "900"))
         extras = [
             (
                 "bank",

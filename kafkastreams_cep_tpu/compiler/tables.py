@@ -91,6 +91,7 @@ class TransitionTables:
     predicates: List[Matcher]  # predicate dispatch list (P entries)
     state_names: List[str]  # fold-state names, first-appearance order
     state_inits: List  # declared init per state name
+    state_dtypes: List[str]  # "int32" | "float32" per state name
     aggs: List[AggSlot]  # flat fold list, per-stage declaration order
     begin_pos: int
     final_pos: int
@@ -196,6 +197,7 @@ def lower(pattern_or_stages) -> TransitionTables:
 
     state_names: List[str] = []
     state_inits: List = []
+    state_dtypes: List[str] = []
     aggs: List[AggSlot] = []
 
     for i, stage in enumerate(nodes):
@@ -203,6 +205,12 @@ def lower(pattern_or_stages) -> TransitionTables:
             if agg.name not in state_names:
                 state_names.append(agg.name)
                 state_inits.append(agg.init)
+                state_dtypes.append(agg.resolved_dtype)
+            elif state_dtypes[state_names.index(agg.name)] != agg.resolved_dtype:
+                raise ValueError(
+                    f"fold state {agg.name!r} declared with conflicting "
+                    f"dtypes across stages"
+                )
             aggs.append(AggSlot(i, state_names.index(agg.name), agg.fn, agg.name))
 
         for edge in stage.edges:
@@ -274,6 +282,7 @@ def lower(pattern_or_stages) -> TransitionTables:
         predicates=predicates,
         state_names=state_names,
         state_inits=state_inits,
+        state_dtypes=state_dtypes,
         aggs=aggs,
         begin_pos=begin_pos,
         final_pos=final_pos,

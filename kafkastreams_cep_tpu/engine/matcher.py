@@ -160,7 +160,8 @@ class EngineState(NamedTuple):
     event_off: jnp.ndarray  # [R] int32 — -1 = none
     start_ts: jnp.ndarray  # [R] int32
     branching: jnp.ndarray  # [R] bool
-    agg: jnp.ndarray  # [R, NS] float32
+    agg: jnp.ndarray  # [R, NS] int32 — typed-encoded fold state (float32
+    #   states stored as their bit pattern; see _build_step)
     slab: slab_mod.SlabState
     run_drops: jnp.ndarray  # scalar int32 — queue-overflow drops
     ver_overflows: jnp.ndarray  # scalar int32 — Dewey add_stage overflows
@@ -271,7 +272,7 @@ class _ChainRecord(NamedTuple):
     br_eval: jnp.ndarray  # [H] — branch-run eval (= frame stage)
     br_event: jnp.ndarray  # [H]
     br_start: jnp.ndarray  # [H]
-    br_agg: jnp.ndarray  # [H, NS]
+    br_agg: jnp.ndarray  # [H, NS] — typed-encoded
     final_agg: jnp.ndarray  # [NS] — survivor fold state (all folds applied)
     has_succ: jnp.ndarray
     dead: jnp.ndarray
@@ -305,13 +306,48 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
     begin_pos = int(tables.begin_pos)
     predicates = tables.predicates
     state_names = tables.state_names
+    # Typed fold state (the array analog of the reference's generic
+    # ``Aggregator<K, V, T>``, ``Aggregator.java:22-25``): every state is
+    # STORED as int32 — float32 states as their bit pattern — so the
+    # structural machinery (branch copies, queue compaction, checkpoints)
+    # is dtype-blind and bit-exact, and int32 folds stay exact past
+    # float32's 2^24 integer range.  Values are decoded/encoded only at
+    # the fold and predicate boundaries.
+    is_float = [d == "float32" for d in tables.state_dtypes]
+
+    def _enc_host(x, flt):
+        if flt:
+            return int(np.float32(x).view(np.int32))
+        return int(np.int32(x))
+
     inits = jnp.asarray(
-        [float(x) for x in tables.state_inits] or [0.0], dtype=jnp.float32
+        [
+            _enc_host(x, f)
+            for x, f in zip(tables.state_inits, is_float)
+        ]
+        or [0],
+        dtype=jnp.int32,
     )
+
+    def dec(v, flt):
+        return jax.lax.bitcast_convert_type(v, jnp.float32) if flt else v
+
+    def enc(v, flt):
+        if flt:
+            return jax.lax.bitcast_convert_type(
+                jnp.asarray(v, jnp.float32), jnp.int32
+            )
+        return jnp.asarray(v, jnp.int32)
+
     aggs = tables.aggs
 
     def eval_preds(key, value, ts, agg_row):
-        states = ArrayStates({n: agg_row[i] for i, n in enumerate(state_names)})
+        states = ArrayStates(
+            {
+                n: dec(agg_row[i], is_float[i])
+                for i, n in enumerate(state_names)
+            }
+        )
         vals = [_as_bool(p(key, value, ts, states)) for p in predicates]
         return jnp.stack(vals)
 
@@ -461,7 +497,10 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             br_agg[h] = jnp.where(copy_mask, s, inits)
             for slot in aggs:
                 cond = consumed_h[h] & (frame_pos[h] == slot.stage)
-                val = jnp.asarray(slot.fn(key, value, s[slot.state]), jnp.float32)
+                val = enc(
+                    slot.fn(key, value, dec(s[slot.state], is_float[slot.state])),
+                    is_float[slot.state],
+                )
                 s = s.at[slot.state].set(jnp.where(cond, val, s[slot.state]))
         final_agg = s
 
@@ -758,7 +797,7 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             event_off=jnp.full((R,), -1, i32),
             start_ts=jnp.full((R,), -1, i32),
             branching=jnp.zeros((R,), bool),
-            agg=jnp.broadcast_to(inits, (R, NS)).astype(jnp.float32),
+            agg=jnp.broadcast_to(inits, (R, NS)),
             slab=slab_mod.make(cfg.slab_entries, cfg.slab_preds, D),
             run_drops=jnp.zeros((), i32),
             ver_overflows=jnp.zeros((), i32),

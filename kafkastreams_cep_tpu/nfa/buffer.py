@@ -109,7 +109,14 @@ class SharedVersionedBuffer:
     def branch(self, stage: Stage, event: Event, version: DeweyVersion) -> None:
         pointer: Optional[Pointer] = Pointer(version, _stack_key(stage, event))
         while pointer is not None and pointer.key is not None:
-            entry = self.store[pointer.key]
+            entry = self.store.get(pointer.key)
+            if entry is None:
+                # The reference NPEs here (KVSharedVersionedBuffer.java:
+                # 102-108 dereferences store.get unchecked); reachable when
+                # sibling runs sharing a path die in one event (e.g. window
+                # pruning).  A crash is not a semantics — the walk stops,
+                # matching the array engine's counted-miss behavior.
+                break
             entry.refs += 1
             pointer = entry.pointer_by_version(pointer.version)
 
@@ -124,7 +131,9 @@ class SharedVersionedBuffer:
         sequence = Sequence()
         while pointer is not None and pointer.key is not None:
             key = pointer.key
-            entry = self.store[key]
+            entry = self.store.get(key)
+            if entry is None:
+                break  # reference-NPE state; see branch() above
             refs_left = entry.decrement()
             if remove and refs_left == 0 and len(entry.preds) <= 1:
                 del self.store[key]

@@ -39,6 +39,8 @@ import dataclasses
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from kafkastreams_cep_tpu.compiler.stages import (
     Edge,
     EdgeOperation,
@@ -132,7 +134,15 @@ class OracleNFA:
         self,
         stages: List[Stage],
         buffer: Optional[SharedVersionedBuffer] = None,
+        enforce_windows: bool = False,
     ):
+        # ``enforce_windows`` mirrors ``EngineConfig.enforce_windows``: the
+        # documented deviation that prunes runs by the *evaluation* stage's
+        # window (the epsilon wrapper's PROCEED target), where the faithful
+        # default reproduces the reference's quirk that epsilon wrappers
+        # drop ``windowMs`` (``Stage.java:41-46``) so ``within()`` never
+        # actually prunes.
+        self.enforce_windows = bool(enforce_windows)
         self.stages = stages
         self.buffer = buffer if buffer is not None else SharedVersionedBuffer()
         self.runs: Deque[Run] = deque(
@@ -144,13 +154,21 @@ class OracleNFA:
         self._agg_state: Dict[Tuple[str, int], Any] = {}
         # Declared init per state name (see pattern/aggregator.py deviation note).
         self._state_inits: Dict[str, Any] = {}
+        # Typed fold state (the Aggregator<K,V,T> analog): the oracle
+        # mirrors the array engine's storage casts exactly — int32 states
+        # truncate toward zero and wrap, float32 states round to IEEE
+        # single — so engine/oracle parity holds for every fold result.
+        self._state_dtypes: Dict[str, str] = {}
         for stage in stages:
             for agg in stage.aggregates:
                 self._state_inits.setdefault(agg.name, agg.init)
+                self._state_dtypes.setdefault(agg.name, agg.resolved_dtype)
 
     @classmethod
-    def from_pattern(cls, pattern: Pattern) -> "OracleNFA":
-        return cls(compile_pattern(pattern))
+    def from_pattern(
+        cls, pattern: Pattern, enforce_windows: bool = False
+    ) -> "OracleNFA":
+        return cls(compile_pattern(pattern), enforce_windows=enforce_windows)
 
     # ------------------------------------------------------------------
     # fold state
@@ -159,6 +177,11 @@ class OracleNFA:
         return self._agg_state.get((name, seq), self._state_inits.get(name))
 
     def _set_state(self, name: str, seq: int, value) -> None:
+        if self._state_dtypes.get(name) == "float32":
+            value = float(np.float32(value))
+        else:
+            v = int(value)  # truncate toward zero, like jnp int32 cast
+            value = ((v + 2**31) % 2**32) - 2**31
         self._agg_state[(name, seq)] = value
 
     def _branch_state(self, name: str, seq: int, new_seq: int) -> None:
@@ -216,9 +239,23 @@ class OracleNFA:
         if run.event is not None:
             self.buffer.remove(run.stage, run.event, run.version)
 
+    def _enforced_out_of_window(self, run: Run, ts: int) -> bool:
+        """The engine's ``enforce_windows`` rule (engine/matcher.py): prune
+        by the evaluation stage's window; BEGIN-typed runs are exempt (their
+        window start resets to the current event, ``NFA.java:347-349``)."""
+        if run.is_begin():
+            return False
+        eval_stage = (
+            run.stage.edges[0].target if run.stage.is_epsilon() else run.stage
+        )
+        w = eval_stage.window_ms
+        return w != -1 and (ts - run.start_ts) > w
+
     def _match_one(self, ctx: _Ctx) -> List[Run]:
         run = ctx.run
         if not run.is_begin() and run.is_out_of_window(ctx.ts):
+            return []
+        if self.enforce_windows and self._enforced_out_of_window(run, ctx.ts):
             return []
         successors = self._evaluate(ctx, run.stage, None)
         if run.is_begin() and not run.is_forwarding():

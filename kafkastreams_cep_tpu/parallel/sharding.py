@@ -12,6 +12,7 @@ by construction and scales linearly by design.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -27,7 +28,10 @@ from kafkastreams_cep_tpu.engine.matcher import (
     counter_values,
 )
 from kafkastreams_cep_tpu.parallel.batch import (
+    _select_walk_kernel,
     broadcast_state,
+    kernel_lane_scan,
+    kernel_lane_step,
     lane_scan,
     lane_step,
 )
@@ -71,8 +75,20 @@ class ShardedMatcher:
             )
         self.num_lanes = int(num_lanes)
         spec = P(self.axis)
-        local_step = lane_step(self.matcher._step_fn)
-        local_scan = lane_scan(self.matcher._step_fn)
+        # Each shard steps K/n lanes with the same code as BatchMatcher —
+        # including the fused walk kernel when the per-shard lane count
+        # allows it (Pallas composes with shard_map; lanes never cross
+        # shards, so the kernel sees an ordinary lane batch).
+        use_kernel, interpret = _select_walk_kernel(
+            self.matcher.config, self.num_lanes // n
+        )
+        self.uses_walk_kernel = use_kernel
+        if use_kernel:
+            local_step = kernel_lane_step(self.matcher, interpret)
+            local_scan = kernel_lane_scan(local_step)
+        else:
+            local_step = lane_step(self.matcher._step_fn)
+            local_scan = lane_scan(self.matcher._step_fn)
 
         def local_stats(state):
             local = jnp.stack(
@@ -108,3 +124,36 @@ class ShardedMatcher:
         vals = jax.device_get(self._stats(state))
         keys = COUNTER_NAMES + ("alive_runs",)
         return {k: int(v) for k, v in zip(keys, vals)}
+
+    def counters(self, state: EngineState) -> Dict[str, int]:
+        """Overflow/drop counters summed over all lanes — the
+        :class:`BatchMatcher` interface, so the runtime layer (processor,
+        supervisor, checkpoint) is matcher-agnostic."""
+        stats = self.stats(state)
+        return {k: stats[k] for k in COUNTER_NAMES}
+
+    def sweep(self, state: EngineState) -> EngineState:
+        """Slab mark-sweep over every shard (lane-elementwise — XLA keeps
+        the existing sharding; no collectives)."""
+        return self._sweep_jit(state)
+
+    @functools.cached_property
+    def _sweep_jit(self):
+        from kafkastreams_cep_tpu.ops import slab as slab_mod
+
+        depth = self.matcher.config.max_walk
+
+        def local(state: EngineState) -> EngineState:
+            run_off = jnp.where(state.alive, state.event_off, -1)
+            slab = jax.vmap(
+                lambda s, ro: slab_mod.mark_sweep(s, None, ro, depth)
+            )(state.slab, run_off)
+            return state._replace(slab=slab)
+
+        spec = P(self.axis)
+        return jax.jit(
+            jax.shard_map(
+                local, mesh=self.mesh, in_specs=spec, out_specs=spec,
+                check_vma=False,
+            )
+        )

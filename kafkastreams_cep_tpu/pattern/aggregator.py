@@ -27,8 +27,27 @@ class StateAggregator:
     """A named fold: ``fn(key, value, current) -> new`` with initial value.
 
     Mirrors ``pattern/StateAggregator.java:20-37`` plus the explicit ``init``.
+    ``dtype`` is the device storage type of the state — the array analog of
+    the reference's generic ``Aggregator<K, V, T>`` (``Aggregator.java:
+    22-25``): ``"int32"`` folds stay exact past float32's 2^24 integer
+    range, ``"float32"`` is IEEE single.  ``None`` infers from ``init``'s
+    Python type (float -> float32, int/bool -> int32).  Fold return values
+    are cast to the state dtype, like assigning to a typed Java field.
     """
 
     name: str
     fn: AggregatorFn
     init: Any = 0
+    dtype: Any = None
+
+    @property
+    def resolved_dtype(self) -> str:
+        if self.dtype is not None:
+            d = str(self.dtype)
+            if d not in ("int32", "float32"):
+                raise ValueError(
+                    f"fold state {self.name!r}: dtype must be 'int32' or "
+                    f"'float32', got {self.dtype!r}"
+                )
+            return d
+        return "float32" if isinstance(self.init, float) else "int32"

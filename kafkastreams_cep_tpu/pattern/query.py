@@ -82,8 +82,12 @@ class PredicateBuilder:
         self._pattern.add_predicate(matcher)
         return self
 
-    def fold(self, state: str, aggregator, init: Any = 0) -> "PredicateBuilder":
-        self._pattern.add_aggregator(StateAggregator(state, aggregator, init))
+    def fold(
+        self, state: str, aggregator, init: Any = 0, dtype: Any = None
+    ) -> "PredicateBuilder":
+        self._pattern.add_aggregator(
+            StateAggregator(state, aggregator, init, dtype)
+        )
         return self
 
     def within(self, time: float, unit: str = "ms") -> "PredicateBuilder":

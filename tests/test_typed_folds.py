@@ -1,0 +1,108 @@
+"""Typed fold state — exact int32 folds past float32's 2^24 integer range.
+
+The reference's ``Aggregator<K, V, T>`` is generic (``Aggregator.java:
+22-25``); the array engine's analog is a per-state dtype declared by the
+``init`` value's Python type (or an explicit ``dtype=``), stored
+typed-encoded in one int32 array (``engine/matcher.py``).  The fuzz family
+here drives an integer fold across 2^24 — where a float32-stored fold
+loses exactness — and asserts exact oracle parity on matches whose
+predicates read the fold value.
+"""
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import OracleNFA, Query, TPUMatcher
+from kafkastreams_cep_tpu.engine import EngineConfig
+from kafkastreams_cep_tpu.engine.matcher import MatcherSession
+from kafkastreams_cep_tpu.pattern.aggregator import StateAggregator
+
+# Sized for the 40-event fuzz horizon: a Kleene match can take at nearly
+# every event, so walks reach ~#events hops.
+CFG = EngineConfig(
+    max_runs=12, slab_entries=96, slab_preds=6, dewey_depth=12, max_walk=44
+)
+
+# Step chosen so the running sum crosses 2^24 quickly and lands on values
+# whose low bits float32 cannot represent (odd increments near 2^24).
+BIG = (1 << 23) + 1
+
+
+def sum_pattern():
+    """Sum big odd increments; completion requires an exact parity test on
+    the sum — any float32 rounding of the fold flips the predicate."""
+    return (
+        Query()
+        .select("start").where(lambda k, v, ts, st: v["x"] == 5)
+        .then()
+        .select("acc").one_or_more().skip_till_next_match()
+        .where(lambda k, v, ts, st: 0 < v["x"]).and_(
+            lambda k, v, ts, st: v["x"] < 5
+        )
+        .fold("sum", lambda k, v, curr: curr + v["x"] * BIG, init=0)
+        .then()
+        .select("end")
+        .where(lambda k, v, ts, st: (st.get("sum") % 4) == 2)
+        .and_(lambda k, v, ts, st: v["x"] == 0)
+        .build()
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_int_fold_past_2_24_matches_oracle(seed):
+    rng = np.random.default_rng(800 + seed)
+    pattern = sum_pattern()
+    oracle = OracleNFA.from_pattern(pattern)
+    sess = MatcherSession(TPUMatcher(pattern, CFG))
+    crossed = False
+    for i in range(40):
+        x = int(rng.integers(0, 6))  # 0 = probe, 1-4 = adds, 5 = start
+        mo = oracle.match(None, {"x": x}, i, offset=i)
+        me = sess.match(None, {"x": x}, i, offset=i)
+        assert [m.as_map() for m in mo] == [m.as_map() for m in me], (
+            f"seed={seed} event {i}: oracle {mo} engine {me}"
+        )
+        crossed = crossed or any(
+            isinstance(v, int) and v > (1 << 24)
+            for v in oracle._agg_state.values()
+        )
+    # The fold values really crossed float32's exact-integer range.
+    assert crossed
+
+
+def test_float_fold_keeps_float_semantics():
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] > 0)
+        .fold("ema", lambda k, v, curr: 0.5 * curr + 0.25 * v["x"], init=0.0)
+        .then()
+        .select("b").where(lambda k, v, ts, st: st.get("ema") > 0.7)
+        .build()
+    )
+    oracle = OracleNFA.from_pattern(pattern)
+    sess = MatcherSession(TPUMatcher(pattern, CFG))
+    for i, x in enumerate([3, 2, 1, 5, 2, 1]):
+        mo = oracle.match(None, {"x": x}, i, offset=i)
+        me = sess.match(None, {"x": x}, i, offset=i)
+        assert [m.as_map() for m in mo] == [m.as_map() for m in me], i
+
+
+def test_conflicting_dtype_declarations_rejected():
+    with pytest.raises(ValueError, match="conflicting"):
+        TPUMatcher(
+            Query()
+            .select("a").where(lambda k, v, ts, st: v["x"] > 0)
+            .fold("s", lambda k, v, curr: curr + 1, init=0)
+            .then()
+            .select("b").where(lambda k, v, ts, st: v["x"] < 0)
+            .fold("s", lambda k, v, curr: curr + 0.5, init=0.0)
+            .build(),
+            CFG,
+        )
+
+
+def test_explicit_dtype_overrides_init_inference():
+    agg = StateAggregator("s", lambda k, v, c: c + 1, init=0, dtype="float32")
+    assert agg.resolved_dtype == "float32"
+    with pytest.raises(ValueError, match="dtype"):
+        StateAggregator("s", lambda k, v, c: c, dtype="int64").resolved_dtype
