@@ -72,6 +72,20 @@ class AggSlot:
     name: str
 
 
+def stackable(tables) -> bool:
+    """Whether these compiled queries share a stackable table shape —
+    the single source of truth for ``_build_step``'s stacked mode and
+    ``parallel/stacked.py``."""
+    t0 = tables[0]
+    return all(
+        t.num_stages == t0.num_stages
+        and t.max_hops == t0.max_hops
+        and int(t.begin_pos) == int(t0.begin_pos)
+        and int(t.final_pos) == int(t0.final_pos)
+        for t in tables[1:]
+    )
+
+
 @dataclasses.dataclass
 class TransitionTables:
     """Dense NFA tables, position-indexed in chain order ``[begin .. $final]``."""

@@ -71,10 +71,11 @@ from kafkastreams_cep_tpu.compiler.tables import (
     TYPE_BEGIN,
     TransitionTables,
     lower,
+    stackable,
 )
 from kafkastreams_cep_tpu.ops import dewey_ops
 from kafkastreams_cep_tpu.ops import slab as slab_mod
-from kafkastreams_cep_tpu.ops.onehot import get_at, put_at
+from kafkastreams_cep_tpu.ops.onehot import get_at, get_at2, put_at
 from kafkastreams_cep_tpu.pattern.pattern import Pattern
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 
@@ -279,41 +280,73 @@ class _ChainRecord(NamedTuple):
     ovf: jnp.ndarray  # int32 — Dewey overflows in this chain
 
 
-def _build_step(tables: TransitionTables, cfg: EngineConfig):
-    """Compile the per-event step for one pattern — a pure jittable fn."""
+def _build_step(tables, cfg: EngineConfig):
+    """Compile the per-event step — a pure jittable fn.
+
+    ``tables`` is one :class:`TransitionTables` or a LIST of them sharing
+    the compiled table shape: a *stacked bank* (BASELINE.json config 4).
+    Stacked tables ride a leading query axis selected per lane by a traced
+    ``qid``; per-query predicates and folds are statically merged, so N
+    same-shape queries run as one compiled program over ``N x K`` lanes
+    instead of N dispatches.
+    """
+    tlist = list(tables) if isinstance(tables, (list, tuple)) else [tables]
+    tables = tlist[0]
+    Q = len(tlist)
+    if not stackable(tlist):
+        raise ValueError(
+            "stacked patterns must share the compiled table shape "
+            "(stage count, chain depth, begin/final positions); "
+            "fall back to one matcher per query otherwise"
+        )
     R, D, W = cfg.max_runs, cfg.dewey_depth, cfg.max_walk
     H = tables.max_hops
-    NS = max(tables.num_states, 1)
+    NS = max(max(t.num_states for t in tlist), 1)
     S_CAND = 1 + H + 1  # survivor, branch per hop, re-seed
 
-    ident = jnp.asarray(tables.ident)
-    types = jnp.asarray(tables.types)
-    consume_op = jnp.asarray(tables.consume_op)
-    consume_pred = jnp.asarray(tables.consume_pred)
-    consume_target = jnp.asarray(tables.consume_target)
-    ignore_pred = jnp.asarray(tables.ignore_pred)
-    proceed_pred = jnp.asarray(tables.proceed_pred)
-    proceed_target = jnp.asarray(tables.proceed_target)
+    # Per-query predicate-id offsets into the merged dispatch list.
+    pred_base = np.cumsum([0] + [len(t.predicates) for t in tlist])[:-1]
+
+    def stk(get, offset=False):
+        rows = []
+        for q, t in enumerate(tlist):
+            a = np.asarray(get(t))
+            if offset and Q > 1:
+                a = np.where(a >= 0, a + pred_base[q], a)
+            rows.append(a)
+        return jnp.asarray(np.stack(rows))  # [Q, S]
+
+    ident = stk(lambda t: t.ident)
+    types = stk(lambda t: t.types)
+    consume_op = stk(lambda t: t.consume_op)
+    consume_pred = stk(lambda t: t.consume_pred, offset=True)
+    consume_target = stk(lambda t: t.consume_target)
+    ignore_pred = stk(lambda t: t.ignore_pred, offset=True)
+    proceed_pred = stk(lambda t: t.proceed_pred, offset=True)
+    proceed_target = stk(lambda t: t.proceed_target)
     # Device time is int32 (TPU-native width; callers rebase epoch-ms via
     # the runtime's `epoch`, runtime/processor.py).  Windows must fit too.
-    if tables.window_ms.max(initial=-1) > np.iinfo(np.int32).max:
-        raise ValueError(
-            f"window of {int(tables.window_ms.max())} ms exceeds int32 device "
-            "time; windows up to ~24.8 days are supported"
-        )
-    window_ms = jnp.asarray(tables.window_ms.astype(np.int32))
+    for t in tlist:
+        if t.window_ms.max(initial=-1) > np.iinfo(np.int32).max:
+            raise ValueError(
+                f"window of {int(t.window_ms.max())} ms exceeds int32 device "
+                "time; windows up to ~24.8 days are supported"
+            )
+    window_ms = stk(lambda t: t.window_ms.astype(np.int32))
     final_pos = int(tables.final_pos)
     begin_pos = int(tables.begin_pos)
-    predicates = tables.predicates
-    state_names = tables.state_names
     # Typed fold state (the array analog of the reference's generic
     # ``Aggregator<K, V, T>``, ``Aggregator.java:22-25``): every state is
     # STORED as int32 — float32 states as their bit pattern — so the
     # structural machinery (branch copies, queue compaction, checkpoints)
     # is dtype-blind and bit-exact, and int32 folds stay exact past
     # float32's 2^24 integer range.  Values are decoded/encoded only at
-    # the fold and predicate boundaries.
-    is_float = [d == "float32" for d in tables.state_dtypes]
+    # the fold and predicate boundaries.  Per query when stacked.
+    is_float_q = [
+        [d == "float32" for d in t.state_dtypes]
+        + [False] * (NS - t.num_states)
+        for t in tlist
+    ]
 
     def _enc_host(x, flt):
         if flt:
@@ -322,12 +355,18 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
 
     inits = jnp.asarray(
         [
-            _enc_host(x, f)
-            for x, f in zip(tables.state_inits, is_float)
-        ]
-        or [0],
+            [
+                _enc_host(x, f)
+                for x, f in zip(
+                    list(t.state_inits) + [0] * (NS - t.num_states),
+                    is_float_q[q],
+                )
+            ]
+            or [0]
+            for q, t in enumerate(tlist)
+        ],
         dtype=jnp.int32,
-    )
+    )  # [Q, NS]
 
     def dec(v, flt):
         return jax.lax.bitcast_convert_type(v, jnp.float32) if flt else v
@@ -339,24 +378,35 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             )
         return jnp.asarray(v, jnp.int32)
 
-    aggs = tables.aggs
+    def inits_of(qid):
+        return inits[0] if Q == 1 else get_at(inits, qid)
 
     def eval_preds(key, value, ts, agg_row):
-        states = ArrayStates(
-            {
-                n: dec(agg_row[i], is_float[i])
-                for i, n in enumerate(state_names)
-            }
-        )
-        vals = [_as_bool(p(key, value, ts, states)) for p in predicates]
+        """ALL queries' predicates against the lane's fold state — each
+        query decodes the shared agg row through its own names/dtypes, and
+        its table entries index the merged list via ``pred_base``."""
+        vals = []
+        for q, t in enumerate(tlist):
+            states = ArrayStates(
+                {
+                    n: dec(agg_row[i], is_float_q[q][i])
+                    for i, n in enumerate(t.state_names)
+                }
+            )
+            vals.extend(
+                _as_bool(pr(key, value, ts, states)) for pr in t.predicates
+            )
         return jnp.stack(vals)
 
     # All traced-index reads below go through one-hot selects (ops/onehot)
     # instead of gathers/scatters so the whole chain fuses on TPU — see the
-    # implementation note in ops/slab.py.
-    def tbl(table, idx):
-        """``table[idx]`` for a static per-stage table and traced index."""
-        return get_at(table, idx)
+    # implementation note in ops/slab.py.  Tables carry a leading query
+    # axis; Q == 1 resolves it statically.
+    def tbl(table, idx, qid):
+        """``table[qid][idx]`` for a static table and traced indices."""
+        if Q == 1:
+            return get_at(table[0], idx)
+        return get_at2(table, qid, idx)
 
     def pv(preds, pid):
         """Predicate value by id; ``-1`` (absent edge) is False."""
@@ -364,7 +414,7 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
 
     def chain_one(
         alive, id_pos, eval_pos, ver, vlen, event_off, start_ts0, branching, agg,
-        preds, key, value, ts, off,
+        preds, key, value, ts, off, qid,
     ) -> _ChainRecord:
         """One run's full evaluation chain (``NFA.evaluate``, recursion
         unrolled to the pattern depth)."""
@@ -373,11 +423,11 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         idc = jnp.maximum(id_pos, 0)
         # getFirstPatternTimestamp (NFA.java:347-349): BEGIN-typed runs reset
         # the window start to the current event's timestamp.
-        id_type_begin = seed | (tbl(types, idc) == TYPE_BEGIN)
+        id_type_begin = seed | (tbl(types, idc, qid) == TYPE_BEGIN)
         start = jnp.where(id_type_begin, ts, start_ts0)
 
         if cfg.enforce_windows:
-            w = tbl(window_ms, eval_pos)
+            w = tbl(window_ms, eval_pos, qid)
             out_w = (~id_type_begin) & (w != -1) & (ts - start_ts0 > w)
         else:
             # Faithful: epsilon wrappers carry windowMs == -1
@@ -389,7 +439,7 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         # stage off a non-branching run appends ".0".  A branching run never
         # appends (its flag survives the whole chain because setVersion — the
         # only thing that clears it — is itself gated on not-branching).
-        cross0 = tbl(ident, eval_pos) != idc
+        cross0 = tbl(ident, eval_pos, qid) != idc
         do_add0 = active & ~seed & cross0 & ~branching
         _, vlen_a, ovf0 = dewey_ops.add_stage(ver, vlen)
         vl = jnp.where(do_add0, vlen_a, vlen)
@@ -417,12 +467,12 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
 
         for _h in range(H):
             cs = jnp.maximum(cur, 0)
-            cop = tbl(consume_op, cs)
-            cp = pv(preds, tbl(consume_pred, cs))
+            cop = tbl(consume_op, cs, qid)
+            cp = pv(preds, tbl(consume_pred, cs, qid))
             take_m = active & (cop == OP_TAKE) & cp
             begin_m = active & (cop == OP_BEGIN) & cp
-            ig_m = active & pv(preds, tbl(ignore_pred, cs))
-            pr_m = active & pv(preds, tbl(proceed_pred, cs))
+            ig_m = active & pv(preds, tbl(ignore_pred, cs, qid))
+            pr_m = active & pv(preds, tbl(proceed_pred, cs, qid))
             # The 4-pair nondeterministic branching rule (NFA.java:280-289).
             branch_m = (pr_m & take_m) | (ig_m & take_m) | (ig_m & begin_m) | (ig_m & pr_m)
             branch_m = branch_m & (prev >= 0)  # unreachable for seeds; guard
@@ -434,8 +484,8 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             sb = begin_m  # advance (NFA.java:210-222), kept even when branching
             si = ig_m & ~branch_m  # unchanged re-add (NFA.java:223-227)
             fire = st | sb | si
-            tgt = tbl(consume_target, cs)
-            surv_id = jnp.where(fire, jnp.where(si, id_pos, tbl(ident, cs)), surv_id)
+            tgt = tbl(consume_target, cs, qid)
+            surv_id = jnp.where(fire, jnp.where(si, id_pos, tbl(ident, cs, qid)), surv_id)
             surv_eval = jnp.where(
                 fire, jnp.where(st, cs, jnp.where(sb, tgt, eval_pos)), surv_eval
             )
@@ -450,20 +500,20 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             # Consuming put; on a branching TAKE the event is recorded under
             # the bumped version and no successor is emitted (NFA.java:206-208).
             put_en.append(consumed)
-            put_cur.append(tbl(ident, cs))
-            put_prev.append(jnp.where(prev >= 0, tbl(ident, jnp.maximum(prev, 0)), i32(-1)))
+            put_cur.append(tbl(ident, cs, qid))
+            put_prev.append(jnp.where(prev >= 0, tbl(ident, jnp.maximum(prev, 0), qid), i32(-1)))
             put_ver.append(jnp.where(take_m & branch_m, dewey_ops.add_run(vv, vl), vv))
             put_vlen.append(vl)
 
             # Branch run (NFA.java:231-246): eps(previous, current), version
             # addRun, pointer event = previous when the frame also ignored.
             br_en.append(branch_m)
-            br_prev.append(tbl(ident, jnp.maximum(prev, 0)))
+            br_prev.append(tbl(ident, jnp.maximum(prev, 0), qid))
             br_ver.append(vv)
             br_vlen.append(vl)
             br_run_ver.append(dewey_ops.add_run(vv, vl))
             br_run_vlen.append(vl)
-            br_id.append(tbl(ident, jnp.maximum(prev, 0)))
+            br_id.append(tbl(ident, jnp.maximum(prev, 0), qid))
             br_eval.append(cs)
             br_event.append(jnp.where(ig_m, event_off, off))
             br_start.append(start)
@@ -471,9 +521,9 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             frame_pos.append(cs)
 
             # PROCEED recursion (NFA.java:182-190).
-            ptgt = tbl(proceed_target, cs)
+            ptgt = tbl(proceed_target, cs, qid)
             ptc = jnp.maximum(ptgt, 0)
-            do_add = pr_m & (tbl(ident, ptc) != tbl(ident, cs)) & ~branching
+            do_add = pr_m & (tbl(ident, ptc, qid) != tbl(ident, cs, qid)) & ~branching
             _, vlen_b, ovf_b = dewey_ops.add_stage(vv, vl)
             vl = jnp.where(do_add, vlen_b, vl)
             ovf = ovf + jnp.where(do_add & ovf_b, 1, 0).astype(i32)
@@ -487,21 +537,29 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         # (NFA.java:243 runs before :248), restricted to the states declared
         # at the branching stage (ValueStore.branch copies only those).
         s = agg
+        inits_l = inits_of(qid)
         br_agg: List[Any] = [None] * H
         for h in range(H - 1, -1, -1):
             copy_mask = jnp.zeros((NS,), bool)
-            for slot in aggs:
-                copy_mask = copy_mask.at[slot.state].set(
-                    copy_mask[slot.state] | (frame_pos[h] == slot.stage)
-                )
-            br_agg[h] = jnp.where(copy_mask, s, inits)
-            for slot in aggs:
-                cond = consumed_h[h] & (frame_pos[h] == slot.stage)
-                val = enc(
-                    slot.fn(key, value, dec(s[slot.state], is_float[slot.state])),
-                    is_float[slot.state],
-                )
-                s = s.at[slot.state].set(jnp.where(cond, val, s[slot.state]))
+            for q, t in enumerate(tlist):
+                qm = True if Q == 1 else (qid == q)
+                for slot in t.aggs:
+                    copy_mask = copy_mask.at[slot.state].set(
+                        copy_mask[slot.state]
+                        | ((frame_pos[h] == slot.stage) & qm)
+                    )
+            br_agg[h] = jnp.where(copy_mask, s, inits_l)
+            for q, t in enumerate(tlist):
+                qm = True if Q == 1 else (qid == q)
+                for slot in t.aggs:
+                    cond = consumed_h[h] & (frame_pos[h] == slot.stage) & qm
+                    flt = is_float_q[q][slot.state]
+                    val = enc(
+                        slot.fn(key, value, dec(s[slot.state], flt)), flt
+                    )
+                    s = s.at[slot.state].set(
+                        jnp.where(cond, val, s[slot.state])
+                    )
         final_agg = s
 
         any_br = jnp.any(jnp.stack(br_en)) if H else jnp.bool_(False)
@@ -521,19 +579,25 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
 
     RH = R * H
 
-    def eval_chain(state: EngineState, ev: EventBatch) -> _ChainRecord:
-        """Predicate evaluation + every run's unrolled chain (per lane)."""
+    def eval_chain(
+        state: EngineState, ev: EventBatch, qid=None
+    ) -> _ChainRecord:
+        """Predicate evaluation + every run's unrolled chain (per lane).
+        ``qid`` selects the lane's query in a stacked bank (None = 0)."""
         i32 = jnp.int32
+        if qid is None:
+            qid = jnp.zeros((), i32)
         key, value = ev.key, ev.value
         ts, off = jnp.asarray(ev.ts, i32), jnp.asarray(ev.off, i32)
         preds = jax.vmap(lambda a: eval_preds(key, value, ts, a))(state.agg)
         return jax.vmap(
             chain_one,
-            in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None, None),
+            in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None, None,
+                     None),
         )(
             state.alive, state.id_pos, state.eval_pos, state.ver, state.vlen,
             state.event_off, state.start_ts, state.branching, state.agg,
-            preds, key, value, ts, off,
+            preds, key, value, ts, off, qid,
         )
 
     def build_walkers(state: EngineState, rec: _ChainRecord, ev: EventBatch):
@@ -583,12 +647,14 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         )
         return slab, (w_en, w_stage, w_off, w_ver, w_vlen, w_remove, w_out)
 
-    def step(state: EngineState, ev: EventBatch) -> Tuple[EngineState, StepOutput]:
+    def step(
+        state: EngineState, ev: EventBatch, qid=None
+    ) -> Tuple[EngineState, StepOutput]:
         i32 = jnp.int32
         off = jnp.asarray(ev.off, i32)
         valid = _as_bool(ev.valid)
 
-        rec = eval_chain(state, ev)
+        rec = eval_chain(state, ev, qid)
 
         # --- Shared-buffer mutations, in the reference's exact op order:
         # per run (queue order): consuming puts frame-by-frame, branch walks
@@ -676,7 +742,8 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
                 budget=cfg.walker_budget, out_base=RH + R, out_rows=R,
             )
 
-        return finish(state, ev, rec, slab, out_stage, out_off, out_count)
+        return finish(state, ev, rec, slab, out_stage, out_off, out_count,
+                      qid)
 
     def finish(
         state: EngineState,
@@ -686,10 +753,14 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         out_stage,
         out_off,
         out_count,
+        qid=None,
     ) -> Tuple[EngineState, StepOutput]:
         """Queue compaction + padding masking (per lane)."""
         i32 = jnp.int32
+        if qid is None:
+            qid = jnp.zeros((), i32)
         valid = _as_bool(ev.valid)
+        inits_l = inits_of(qid)
 
         # --- Next queue: per run [survivor, branches deepest-first, re-seed],
         # flattened in queue order, compacted into R slots (overflow counted).
@@ -732,7 +803,7 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         c_agg = jnp.concatenate(
             [rec.final_agg[:, None, :]]
             + ([rec.br_agg[:, ::-1, :]] if H else [])
-            + [jnp.broadcast_to(inits, (R, NS))[:, None, :]],
+            + [jnp.broadcast_to(inits_l, (R, NS))[:, None, :]],
             axis=1,
         )
 
@@ -785,7 +856,7 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
         )
         return new_state, out
 
-    def init_state() -> EngineState:
+    def init_state(q: int = 0) -> EngineState:
         i32 = jnp.int32
         ver = jnp.zeros((R, D), i32).at[0, 0].set(1)
         return EngineState(
@@ -797,7 +868,7 @@ def _build_step(tables: TransitionTables, cfg: EngineConfig):
             event_off=jnp.full((R,), -1, i32),
             start_ts=jnp.full((R,), -1, i32),
             branching=jnp.zeros((R,), bool),
-            agg=jnp.broadcast_to(inits, (R, NS)),
+            agg=jnp.broadcast_to(inits[q], (R, NS)),
             slab=slab_mod.make(cfg.slab_entries, cfg.slab_preds, D),
             run_drops=jnp.zeros((), i32),
             ver_overflows=jnp.zeros((), i32),

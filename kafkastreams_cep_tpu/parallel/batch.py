@@ -57,7 +57,7 @@ def lane_scan(step_one):
     return scan
 
 
-def kernel_lane_step(matcher: TPUMatcher, interpret: bool = False):
+def kernel_lane_step(phases, interpret: bool = False, qids=None):
     """A ``[K]``-batched step whose walk pass runs the fused Pallas kernel.
 
     The chain and puts phases stay vmapped jnp; the walk pass — ~90% of the
@@ -70,10 +70,14 @@ def kernel_lane_step(matcher: TPUMatcher, interpret: bool = False):
     """
     from kafkastreams_cep_tpu.ops.walk_kernel import walk_pass_kernel
 
-    ph = matcher._phases
+    ph = phases
 
     def step(state: EngineState, ev: EventBatch):
-        rec = jax.vmap(ph.eval_chain)(state, ev)
+        if qids is None:
+            rec = jax.vmap(ph.eval_chain)(state, ev)
+        else:
+            # Stacked bank: each lane evaluates its own query's tables.
+            rec = jax.vmap(ph.eval_chain)(state, ev, qids)
         slab, wk = jax.vmap(ph.build_walkers)(state, rec, ev)
         # (Lane-load sorting was tried here and measured net-negative: in
         # load-sorted blocks every batch runs the full hop bound, erasing
@@ -83,8 +87,12 @@ def kernel_lane_step(matcher: TPUMatcher, interpret: bool = False):
             max_walk=ph.max_walk, out_base=ph.out_base,
             out_rows=ph.out_rows, interpret=interpret,
         )
+        if qids is None:
+            return jax.vmap(ph.finish)(
+                state, ev, rec, slab, out_stage, out_off, out_count
+            )
         return jax.vmap(ph.finish)(
-            state, ev, rec, slab, out_stage, out_off, out_count
+            state, ev, rec, slab, out_stage, out_off, out_count, qids
         )
 
     return step
@@ -161,7 +169,7 @@ class BatchMatcher:
                 "batch matcher: fused walk kernel enabled (%d lanes%s)",
                 self.num_lanes, ", interpret" if interpret else "",
             )
-            self._step_fn = kernel_lane_step(self.matcher, interpret)
+            self._step_fn = kernel_lane_step(self.matcher._phases, interpret)
             self._scan_fn = kernel_lane_scan(self._step_fn)
         else:
             self._step_fn = lane_step(self.matcher._step_fn)

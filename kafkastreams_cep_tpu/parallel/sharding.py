@@ -84,7 +84,7 @@ class ShardedMatcher:
         )
         self.uses_walk_kernel = use_kernel
         if use_kernel:
-            local_step = kernel_lane_step(self.matcher, interpret)
+            local_step = kernel_lane_step(self.matcher._phases, interpret)
             local_scan = kernel_lane_scan(local_step)
         else:
             local_step = lane_step(self.matcher._step_fn)

@@ -1,0 +1,143 @@
+"""Stacked multi-query bank: N same-shape queries in ONE compiled dispatch.
+
+The reference composes multi-query topologies as one ``CEPProcessor`` per
+pattern over the same topic; the serial device analog (``runtime/bank.py``)
+pays one dispatch per query.  When queries lower to the same table shape
+(stage count, chain depth — typical for banks of parameterized variants of
+one query), their tables stack on a leading query axis and a per-lane
+``qid`` selects each lane's query inside the engine step
+(``engine/matcher.py: _build_step`` stacked mode).  N queries x K lanes run
+as ``N*K`` lanes of one program — BASELINE.json config 4's "multi-pattern
+NFA bank, batched".
+
+Use :func:`stackable` to test compatibility and fall back to
+``runtime/bank.py: CEPBank``'s per-query loop otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kafkastreams_cep_tpu.compiler.tables import (
+    TransitionTables,
+    lower,
+    stackable,
+)
+from kafkastreams_cep_tpu.engine.matcher import (
+    COUNTER_NAMES,
+    EngineConfig,
+    EngineState,
+    EventBatch,
+    _build_step,
+    counter_values,
+)
+from kafkastreams_cep_tpu.parallel.batch import (
+    _select_walk_kernel,
+    kernel_lane_scan,
+    kernel_lane_step,
+)
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.stacked")
+
+
+class StackedBankMatcher:
+    """``Q`` same-shape queries x ``K`` lanes each, one compiled program.
+
+    Lane layout: query-major — lane ``q * K + k`` runs query ``q`` over key
+    lane ``k``.  ``scan`` takes per-key events shaped ``[K, T]`` and
+    replicates them across queries (every query sees every record, like the
+    reference's one-processor-per-pattern topology); outputs come back
+    ``[Q, K, T, R, W]`` so callers decode per query with that query's stage
+    names (``names_of``).
+    """
+
+    def __init__(
+        self,
+        patterns: Sequence,
+        lanes_per_query: int,
+        config: Optional[EngineConfig] = None,
+    ):
+        self.tables_list: List[TransitionTables] = [
+            p if isinstance(p, TransitionTables) else lower(p)
+            for p in patterns
+        ]
+        if not stackable(self.tables_list):
+            raise ValueError(
+                "queries do not share a stackable table shape; use "
+                "runtime.bank.CEPBank's per-query loop instead"
+            )
+        self.config = config or EngineConfig()
+        self.Q = len(self.tables_list)
+        self.K = int(lanes_per_query)
+        self.num_lanes = self.Q * self.K
+        logger.info(
+            "stacked bank: %d queries x %d lanes in one dispatch",
+            self.Q, self.K,
+        )
+        step, init_state, phases = _build_step(self.tables_list, self.config)
+        self._step_fn = step
+        self._init_fn = init_state
+        self._phases = phases
+        qids = jnp.repeat(
+            jnp.arange(self.Q, dtype=jnp.int32), self.K
+        )  # [Q*K]
+        self._qids = qids
+
+        use_kernel, interpret = _select_walk_kernel(
+            self.config, self.num_lanes
+        )
+        self.uses_walk_kernel = use_kernel
+        if use_kernel:
+            bstep = kernel_lane_step(phases, interpret, qids=qids)
+            scan = kernel_lane_scan(bstep)
+        else:
+
+            def scan(state: EngineState, events: EventBatch):
+                return jax.vmap(
+                    lambda s, e, q: jax.lax.scan(
+                        lambda c, x: step(c, x, q), s, e
+                    )
+                )(state, events, qids)
+
+        def scan_rep(state: EngineState, events: EventBatch):
+            # Replicate [K, T] events across queries INSIDE the jit so XLA
+            # fuses the broadcast instead of copying Q x [K, T] per call.
+            ev = jax.tree_util.tree_map(
+                lambda x: jnp.concatenate([x] * self.Q, axis=0), events
+            )
+            return scan(state, ev)
+
+        self._scan_fn = scan
+        self.scan_flat = jax.jit(scan_rep)
+
+    def names_of(self, q: int) -> List[str]:
+        return self.tables_list[q].names
+
+    def init_state(self) -> EngineState:
+        """Per-query initial state tiled to the [Q*K] lane axis."""
+        per_q = [self._init_fn(q) for q in range(self.Q)]
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(
+                [jnp.broadcast_to(x, (self.K,) + x.shape) for x in xs]
+            ),
+            *per_q,
+        )
+
+    def scan(self, state: EngineState, events: EventBatch):
+        """Events ``[K, T]`` -> replicated across queries (inside the
+        jit) -> outputs reshaped ``[Q, K, T, ...]``."""
+        state, out = self.scan_flat(state, events)
+        out = jax.tree_util.tree_map(
+            lambda x: x.reshape((self.Q, self.K) + x.shape[1:]), out
+        )
+        return state, out
+
+    def counters(self, state: EngineState) -> Dict[str, int]:
+        return {
+            n: int(jnp.sum(v))
+            for n, v in zip(COUNTER_NAMES, counter_values(state))
+        }

@@ -1,0 +1,129 @@
+"""Stacked multi-query bank vs per-query matchers — identical emissions.
+
+BASELINE.json config 4 ("multi-pattern NFA bank, batched"): same-shape
+queries stack on a leading query axis inside one compiled step
+(``engine/matcher.py`` stacked mode, ``parallel/stacked.py``).  Ground
+truth is one :class:`BatchMatcher` per query over the same events.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import Query
+from kafkastreams_cep_tpu.compiler.tables import lower
+from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.parallel import BatchMatcher
+from kafkastreams_cep_tpu.parallel.stacked import (
+    StackedBankMatcher,
+    stackable,
+)
+
+CFG = EngineConfig(
+    max_runs=8, slab_entries=24, slab_preds=4, dewey_depth=8, max_walk=8
+)
+
+
+def q_threshold(lo, hi):
+    """A parameterized two-stage query — the typical bank member."""
+    return (
+        Query()
+        .select("a").where(lambda k, v, ts, st, lo=lo: v["x"] < lo)
+        .then()
+        .select("b").skip_till_next_match()
+        .where(lambda k, v, ts, st, hi=hi: v["x"] > hi)
+        .build()
+    )
+
+
+def q_folded(mult):
+    """Same shape, with a fold — exercises per-query agg merging."""
+    return (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] < 3)
+        .fold("acc", lambda k, v, curr, m=mult: curr + m * v["x"], init=0)
+        .then()
+        .select("b").skip_till_next_match()
+        .where(lambda k, v, ts, st: v["x"] > st.get("acc"))
+        .build()
+    )
+
+
+def trace(K, T, seed):
+    rng = np.random.default_rng(seed)
+    xs = rng.integers(0, 10, size=(K, T)).astype(np.int32)
+    return EventBatch(
+        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
+        value={"x": jnp.asarray(xs)},
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+
+
+@pytest.mark.parametrize("mk", [q_threshold, q_folded], ids=["plain", "fold"])
+def test_stacked_bank_matches_per_query_matchers(mk):
+    K, T = 8, 48
+    params = [(2, 6), (3, 7), (4, 5)] if mk is q_threshold else [(1,), (2,), (3,)]
+    patterns = [mk(*p) for p in params]
+    ev = trace(K, T, seed=21)
+
+    bank = StackedBankMatcher(patterns, K, CFG)
+    state, out = bank.scan(bank.init_state(), ev)
+
+    single_counters = []
+    for q, pattern in enumerate(patterns):
+        single = BatchMatcher(pattern, K, CFG)
+        s1, o1 = single.scan(single.init_state(), ev)
+        single_counters.append(single.counters(s1))
+        for name, a, b in (
+            ("count", out.count[q], o1.count),
+            ("stage", out.stage[q], o1.stage),
+            ("off", out.off[q], o1.off),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f"query {q} {name}"
+            )
+    assert bank.counters(state) == {
+        k: sum(c[k] for c in single_counters)
+        for k in bank.counters(state)
+    }
+
+
+def test_stacked_bank_kernel_interpret_parity(monkeypatch):
+    """The fused walk kernel path with per-lane qids (interpret mode)."""
+    K = 128
+    params = [(2, 6), (4, 5)]
+    patterns = [q_threshold(*p) for p in params]
+    ev = trace(K, 32, seed=22)
+
+    monkeypatch.setenv("CEP_WALK_KERNEL", "0")
+    jnp_bank = StackedBankMatcher(patterns, K, CFG)
+    assert not jnp_bank.uses_walk_kernel
+    s0, o0 = jnp_bank.scan(jnp_bank.init_state(), ev)
+
+    monkeypatch.setenv("CEP_WALK_KERNEL", "interpret")
+    krn_bank = StackedBankMatcher(patterns, K, CFG)
+    assert krn_bank.uses_walk_kernel
+    s1, o1 = krn_bank.scan(krn_bank.init_state(), ev)
+
+    np.testing.assert_array_equal(np.asarray(o0.count), np.asarray(o1.count))
+    np.testing.assert_array_equal(np.asarray(o0.stage), np.asarray(o1.stage))
+    np.testing.assert_array_equal(np.asarray(o0.off), np.asarray(o1.off))
+
+
+def test_unstackable_shapes_rejected():
+    p2 = q_threshold(2, 6)
+    p3 = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] < 2)
+        .then()
+        .select("b").where(lambda k, v, ts, st: v["x"] > 4)
+        .then()
+        .select("c").where(lambda k, v, ts, st: v["x"] > 8)
+        .build()
+    )
+    assert not stackable([lower(p2), lower(p3)])
+    with pytest.raises(ValueError, match="stackable"):
+        StackedBankMatcher([p2, p3], 8, CFG)
