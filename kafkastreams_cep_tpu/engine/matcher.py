@@ -622,6 +622,11 @@ def _build_step(tables, cfg: EngineConfig):
             ver=rec.put_ver.reshape(RH, D),
             vlen=rec.put_vlen.reshape(RH),
         )
+        # (Rank-compacting the puts like the walk pass was measured
+        # net-negative here: the vmapped batch loop costs every lane the
+        # busiest lane's batch count, and the per-batch gathers outweigh
+        # the smaller group matrices.  puts_batched's O(RH^2) masks fuse
+        # well under XLA.)
         slab = slab_mod.puts_batched(state.slab, ops, off)
 
         def rev(f):
