@@ -239,6 +239,7 @@ class StepPhases(NamedTuple):
     operates on ``[K]``-batched slabs and cannot live under ``vmap``)."""
 
     eval_chain: Any
+    build_puts: Any
     build_walkers: Any
     finish: Any
     out_base: int
@@ -600,8 +601,22 @@ def _build_step(tables, cfg: EngineConfig):
             preds, key, value, ts, off, qid,
         )
 
+    def build_puts(state: EngineState, rec: _ChainRecord, ev: EventBatch):
+        """The step's consuming-put ops (per lane), in reference order:
+        run-major, frame-ascending (``NFA.java`` queue order)."""
+        prev_off_rep = jnp.repeat(state.event_off, H)
+        return slab_mod.PutOps(
+            en=rec.put_en.reshape(RH),
+            first=rec.put_prev.reshape(RH) < 0,
+            cur_stage=rec.put_cur.reshape(RH),
+            prev_stage=rec.put_prev.reshape(RH),
+            prev_off=prev_off_rep,
+            ver=rec.put_ver.reshape(RH, D),
+            vlen=rec.put_vlen.reshape(RH),
+        )
+
     def build_walkers(state: EngineState, rec: _ChainRecord, ev: EventBatch):
-        """Consuming puts + the step's walker-candidate queue (per lane).
+        """The step's walker-candidate queue (per lane; no slab mutation).
 
         Queue layout (reference op order): branch frames deepest-first per
         run ([RH]), dead-run removals ([R]), final extractions ([R]) —
@@ -613,21 +628,6 @@ def _build_step(tables, cfg: EngineConfig):
         final_en = rec.surv_alive & rec.surv_final & valid
 
         prev_off_rep = jnp.repeat(state.event_off, H)
-        ops = slab_mod.PutOps(
-            en=rec.put_en.reshape(RH),
-            first=rec.put_prev.reshape(RH) < 0,
-            cur_stage=rec.put_cur.reshape(RH),
-            prev_stage=rec.put_prev.reshape(RH),
-            prev_off=prev_off_rep,
-            ver=rec.put_ver.reshape(RH, D),
-            vlen=rec.put_vlen.reshape(RH),
-        )
-        # (Rank-compacting the puts like the walk pass was measured
-        # net-negative here: the vmapped batch loop costs every lane the
-        # busiest lane's batch count, and the per-batch gathers outweigh
-        # the smaller group matrices.  puts_batched's O(RH^2) masks fuse
-        # well under XLA.)
-        slab = slab_mod.puts_batched(state.slab, ops, off)
 
         def rev(f):
             return f[:, ::-1].reshape((RH,) + f.shape[2:])
@@ -650,7 +650,7 @@ def _build_step(tables, cfg: EngineConfig):
         w_out = jnp.concatenate(
             [jnp.zeros((RH + R,), bool), jnp.ones((R,), bool)]
         )
-        return slab, (w_en, w_stage, w_off, w_ver, w_vlen, w_remove, w_out)
+        return (w_en, w_stage, w_off, w_ver, w_vlen, w_remove, w_out)
 
     def step(
         state: EngineState, ev: EventBatch, qid=None
@@ -741,7 +741,15 @@ def _build_step(tables, cfg: EngineConfig):
             # extraction (NFA.java:111-115) — compacted in queue-order rank
             # into a small pool (PROFILE_r04.md: carrying all 3R+ slots
             # through every hop was ~90% of the step).
-            slab, wk = build_walkers(state, rec, ev)
+            # (Rank-compacting the puts like the walk pass was measured
+            # net-negative in jnp: the vmapped batch loop costs every lane
+            # the busiest lane's batch count.  puts_batched's O(RH^2)
+            # masks fuse well under XLA; the fused kernel path applies
+            # puts in-kernel instead.)
+            slab = slab_mod.puts_batched(
+                state.slab, build_puts(state, rec, ev), off
+            )
+            wk = build_walkers(state, rec, ev)
             slab, out_stage, out_off, out_count = slab_mod.walks_compacted(
                 slab, *wk, W,
                 budget=cfg.walker_budget, out_base=RH + R, out_rows=R,
@@ -881,6 +889,7 @@ def _build_step(tables, cfg: EngineConfig):
 
     phases = StepPhases(
         eval_chain=eval_chain,
+        build_puts=build_puts,
         build_walkers=build_walkers,
         finish=finish,
         out_base=RH + R,

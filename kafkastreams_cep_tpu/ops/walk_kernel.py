@@ -45,13 +45,15 @@ LANE_BLOCK = 128
 def _kernel(
     # inputs (lane-last blocks)
     stage, off, refs, npreds, pstage, poff, pvlen, pver, missing, trunc,
+    fulld, predd,
+    p_first, p_cur, p_pstage, p_poff, p_vlen, p_ver, p_rank, p_nen, ev_off,
     en, wstage, woff, wvlen, wver, wrem, wout, rank, nen,
     # outputs
     o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
-    o_missing, o_trunc, o_ostage, o_ooff, o_count,
+    o_missing, o_trunc, o_fulld, o_predd, o_ostage, o_ooff, o_count,
     # scratch
     st_stage, st_off,
-    *, W: int, out_base: int, out_rows: int,
+    *, W: int, out_base: int, out_rows: int, with_puts: bool,
 ):
     E, MP, L = pstage.shape
     # pver blocks arrive [D, E, MP, L]: the tiled trailing dims are then
@@ -74,6 +76,8 @@ def _kernel(
     o_pver[:] = pver[:]
     o_missing[:] = missing[:]
     o_trunc[:] = trunc[:]
+    o_fulld[:] = fulld[:]
+    o_predd[:] = predd[:]
     o_ostage[:] = jnp.full((OR, W, L), -1, i32)
     o_ooff[:] = jnp.full((OR, W, L), -1, i32)
     o_count[:] = jnp.zeros((OR, L), i32)
@@ -85,6 +89,90 @@ def _kernel(
     iota_or3 = jax.lax.broadcasted_iota(i32, (OR, W, L), 0)
     iota_w2 = jax.lax.broadcasted_iota(i32, (W, L), 0)
     iota_or2 = jax.lax.broadcasted_iota(i32, (OR, L), 0)
+
+    # ---- consuming-put phase (reference order precedes all walks; one
+    # put per lane per batch in queue-order rank = the sequential
+    # semantics of slab.put / slab.put_first exactly) ----
+    if with_puts:
+        iota_e = jax.lax.broadcasted_iota(i32, (E, L), 0)
+        max_pn = jnp.max(p_nen[0, :])
+
+        def put_body(b):
+            pselm = p_rank[:] == b  # [PP, L] — at most one True per lane
+            en0 = jnp.any(pselm, axis=0, keepdims=True)  # [1, L]
+
+            def ppick(f):
+                return jnp.sum(jnp.where(pselm, f, 0), axis=0, keepdims=True)
+
+            first = jnp.any(
+                pselm & (p_first[:] != 0), axis=0, keepdims=True
+            )
+            cur = ppick(p_cur[:])
+            pst = ppick(p_pstage[:])
+            pof = ppick(p_poff[:])
+            pvl = ppick(p_vlen[:])
+            pvr = jnp.sum(
+                jnp.where(pselm[None], p_ver[:], 0), axis=1
+            )  # [D, L]
+            off_l = ev_off[:]  # [1, L]
+
+            # Chained puts need an existing predecessor entry
+            # (KVSharedVersionedBuffer.java:86-89; counted miss here).
+            prev_hit = (o_stage[:] == pst) & (o_off[:] == pof)
+            prev_found = jnp.any(prev_hit, axis=0, keepdims=True)
+            o_missing[:] = o_missing[:] + jnp.where(
+                en0 & ~first & ~prev_found, 1, 0
+            )
+            en_ok = en0 & (first | prev_found)
+
+            cur_hit = (o_stage[:] == cur) & (o_off[:] == off_l)  # [E, L]
+            exist = jnp.any(cur_hit, axis=0, keepdims=True)
+            free = o_stage[:] < 0
+            ffs = jnp.min(jnp.where(free, iota_e, E), axis=0, keepdims=True)
+            has_free = ffs < E
+            # Boolean algebra, not where(): Mosaic can't select i1 vectors.
+            tgt = (exist & cur_hit) | (~exist & (iota_e == ffs))  # [E, L]
+            ok = en_ok & (exist | has_free)
+            o_fulld[:] = o_fulld[:] + jnp.where(
+                en_ok & ~exist & ~has_free, 1, 0
+            )
+            m1 = tgt & ok
+            # put_first resets the entry (:117-128); creation initializes.
+            reset = ok & (first | ~exist)
+            o_stage[:] = jnp.where(m1, cur, o_stage[:])
+            o_off[:] = jnp.where(m1, off_l, o_off[:])
+            o_refs[:] = jnp.where(m1 & reset, 1, o_refs[:])
+            np_e = jnp.sum(
+                jnp.where(m1, o_npreds[:], 0), axis=0, keepdims=True
+            )
+            n_eff = jnp.where(reset, 0, np_e)  # [1, L]
+            pfull = ok & (n_eff >= MP)
+            o_predd[:] = o_predd[:] + jnp.where(pfull, 1, 0)
+            do = ok & ~pfull
+            slot = jnp.minimum(n_eff, MP - 1)
+            m2 = (
+                m1[:, None, :]
+                & (iota_mp3 == slot[:, None, :])
+                & do[:, None, :]
+            )  # [E, MP, L]
+            o_pstage[:] = jnp.where(
+                m2, jnp.where(first, -1, pst)[:, None, :], o_pstage[:]
+            )
+            o_poff[:] = jnp.where(
+                m2, jnp.where(first, -1, pof)[:, None, :], o_poff[:]
+            )
+            o_pvlen[:] = jnp.where(m2, pvl[:, None, :], o_pvlen[:])
+            o_pver[:] = jnp.where(
+                m2[None], pvr[:, None, None, :], o_pver[:]
+            )
+            o_npreds[:] = jnp.where(
+                m1, n_eff + jnp.where(do, 1, 0), o_npreds[:]
+            )
+            return b + 1
+
+        jax.lax.while_loop(
+            lambda b: b < max_pn, put_body, jnp.zeros((), i32)
+        )
 
     max_n = jnp.max(nen[0, :])
 
@@ -270,12 +358,20 @@ def walk_pass_kernel(
     out_base: int,
     out_rows: int,
     interpret: bool = False,
+    put_ops=None,
+    ev_off=None,
 ) -> Tuple[SlabState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The step's walk pass for a ``[K]``-batched slab via the fused kernel.
 
     Same contract as ``jax.vmap`` of ``ops/slab.py: walks_compacted`` —
     ``K`` must be a multiple of 128.  Returns
     ``(slab, out_stage [K, out_rows, W], out_off, count [K, out_rows])``.
+
+    With ``put_ops`` (a ``[K]``-batched :class:`ops.slab.PutOps`) and
+    ``ev_off`` (``[K]`` current-event offsets), the step's consuming puts
+    apply in-kernel BEFORE the walks — same contract as ``jax.vmap`` of
+    ``puts_batched`` — so the slab crosses HBM once per step instead of
+    twice.
     """
     i32 = jnp.int32
     K, E = slab.stage.shape
@@ -297,6 +393,26 @@ def walk_pass_kernel(
 
     nen = jnp.sum(en_i, axis=1)  # [K]
 
+    with_puts = put_ops is not None
+    if with_puts:
+        p_en_i = jnp.asarray(put_ops.en).astype(i32)
+        p_rank = jnp.where(put_ops.en, jnp.cumsum(p_en_i, axis=1) - 1, -1)
+        put_ins = [
+            tin(jnp.asarray(put_ops.first).astype(i32)),
+            tin(jnp.asarray(put_ops.cur_stage, i32)),
+            tin(jnp.asarray(put_ops.prev_stage, i32)),
+            tin(jnp.asarray(put_ops.prev_off, i32)),
+            tin(jnp.asarray(put_ops.vlen, i32)),
+            jnp.transpose(jnp.asarray(put_ops.ver, i32), (2, 1, 0)),
+            tin(p_rank),
+            row(jnp.sum(p_en_i, axis=1)),
+            row(jnp.asarray(ev_off, i32)),
+        ]
+    else:
+        zc = jnp.zeros((1, K), i32)
+        put_ins = [zc, zc, zc, zc, zc,
+                   jnp.zeros((1, 1, K), i32), zc, zc, zc]
+
     ins = [
         tin(slab.stage),
         tin(slab.off),
@@ -310,6 +426,9 @@ def walk_pass_kernel(
         # Per-lane scalar counters arrive as [K]; kernel blocks want [1, L].
         row(slab.missing),
         row(slab.trunc),
+        row(slab.full_drops),
+        row(slab.pred_drops),
+        *put_ins,
         tin(en_i),
         tin(jnp.asarray(stage, i32)),
         tin(jnp.asarray(off, i32)),
@@ -345,6 +464,8 @@ def walk_pass_kernel(
         jax.ShapeDtypeStruct((D, E, MP, K), i32),  # pver
         jax.ShapeDtypeStruct((1, K), i32),  # missing
         jax.ShapeDtypeStruct((1, K), i32),  # trunc
+        jax.ShapeDtypeStruct((1, K), i32),  # full_drops
+        jax.ShapeDtypeStruct((1, K), i32),  # pred_drops
         jax.ShapeDtypeStruct((OR, W, K), i32),  # out_stage
         jax.ShapeDtypeStruct((OR, W, K), i32),  # out_off
         jax.ShapeDtypeStruct((OR, K), i32),  # count
@@ -353,7 +474,8 @@ def walk_pass_kernel(
 
     outs = pl.pallas_call(
         functools.partial(
-            _kernel, W=W, out_base=out_base, out_rows=out_rows
+            _kernel, W=W, out_base=out_base, out_rows=out_rows,
+            with_puts=with_puts,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -370,7 +492,7 @@ def walk_pass_kernel(
     )(*ins)
 
     (n_stage, n_off, n_refs, n_npreds, n_pstage, n_poff, n_pvlen, n_pver,
-     n_missing, n_trunc, o_stage, o_off, o_count) = outs
+     n_missing, n_trunc, n_fulld, n_predd, o_stage, o_off, o_count) = outs
     new_slab = slab._replace(
         stage=tout(n_stage),
         off=tout(n_off),
@@ -382,6 +504,8 @@ def walk_pass_kernel(
         pver=jnp.transpose(n_pver, (3, 1, 2, 0)),
         missing=unrow(n_missing),
         trunc=unrow(n_trunc),
+        full_drops=unrow(n_fulld),
+        pred_drops=unrow(n_predd),
     )
     return (
         new_slab,

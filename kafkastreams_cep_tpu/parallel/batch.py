@@ -78,14 +78,18 @@ def kernel_lane_step(phases, interpret: bool = False, qids=None):
         else:
             # Stacked bank: each lane evaluates its own query's tables.
             rec = jax.vmap(ph.eval_chain)(state, ev, qids)
-        slab, wk = jax.vmap(ph.build_walkers)(state, rec, ev)
+        ops = jax.vmap(ph.build_puts)(state, rec, ev)
+        wk = jax.vmap(ph.build_walkers)(state, rec, ev)
+        # Both slab phases (consuming puts, then all walks) run inside one
+        # Pallas call: the slab crosses HBM once per step instead of twice.
         # (Lane-load sorting was tried here and measured net-negative: in
         # load-sorted blocks every batch runs the full hop bound, erasing
         # the batch-count win, and the permutation gathers add traffic.)
         slab, out_stage, out_off, out_count = walk_pass_kernel(
-            slab, *wk,
+            state.slab, *wk,
             max_walk=ph.max_walk, out_base=ph.out_base,
             out_rows=ph.out_rows, interpret=interpret,
+            put_ops=ops, ev_off=ev.off,
         )
         if qids is None:
             return jax.vmap(ph.finish)(

@@ -127,3 +127,94 @@ def test_kernel_matches_sequential(seed):
                 np.asarray(count[lane]), exp_ct,
                 err_msg=f"seed={seed} lane={lane} count",
             )
+
+
+def test_kernel_put_phase_matches_sequential():
+    """The in-kernel consuming-put phase vs slab.put/put_first applied one
+    op at a time in rank order — including the rare branches: slab-full
+    drops, pointer-list overflow, mid-rank put_first reset, and chained
+    puts with missing predecessors."""
+    from kafkastreams_cep_tpu.ops.slab import PutOps
+
+    K = LANE_BLOCK
+    PP = 10
+    n_distinct = 8
+    rng = np.random.default_rng(900)
+    lanes = []
+    for i in range(n_distinct):
+        slab = seed_slab(rng)
+        # Tiny slabs/pointer lists so full/pred drops actually fire.
+        ops = dict(
+            en=rng.random(PP) < 0.8,
+            first=rng.random(PP) < 0.4,
+            cur_stage=rng.integers(0, 3, size=PP).astype(np.int32),
+            prev_stage=rng.integers(0, 3, size=PP).astype(np.int32),
+            prev_off=rng.integers(0, 6, size=PP).astype(np.int32),
+        )
+        vers, vlens = [], []
+        for _ in range(PP):
+            comps = tuple(rng.integers(1, 3, size=rng.integers(1, 3)))
+            v, l = dewey_ops.make(comps, D)
+            vers.append(v)
+            vlens.append(l)
+        ops["ver"] = np.stack(vers).astype(np.int32)
+        ops["vlen"] = np.asarray(vlens, np.int32)
+        lanes.append((slab, ops))
+
+    ev_off = 9  # current event offset, shared by every put of the step
+
+    def sequential(slab, ops):
+        for p in range(PP):
+            if not ops["en"][p]:
+                continue
+            if ops["first"][p]:
+                slab = slab_mod.put_first(
+                    slab, int(ops["cur_stage"][p]), ev_off,
+                    jnp.asarray(ops["ver"][p]), jnp.asarray(ops["vlen"][p]),
+                )
+            else:
+                slab = slab_mod.put(
+                    slab, int(ops["cur_stage"][p]), ev_off,
+                    int(ops["prev_stage"][p]), int(ops["prev_off"][p]),
+                    jnp.asarray(ops["ver"][p]), jnp.asarray(ops["vlen"][p]),
+                )
+        return slab
+
+    seq = [sequential(s, o) for s, o in lanes]
+
+    reps = K // n_distinct
+    tile = lambda arrs: jnp.asarray(
+        np.tile(np.stack(arrs), (reps,) + (1,) * arrs[0].ndim)
+    )
+    slab_K = jax.tree_util.tree_map(
+        lambda *xs: jnp.asarray(
+            np.tile(np.stack([np.asarray(x) for x in xs]),
+                    (reps,) + (1,) * xs[0].ndim)
+        ),
+        *[s for s, _ in lanes],
+    )
+    put_ops = PutOps(
+        en=tile([o["en"] for _, o in lanes]),
+        first=tile([o["first"] for _, o in lanes]),
+        cur_stage=tile([o["cur_stage"] for _, o in lanes]),
+        prev_stage=tile([o["prev_stage"] for _, o in lanes]),
+        prev_off=tile([o["prev_off"] for _, o in lanes]),
+        ver=tile([o["ver"] for _, o in lanes]),
+        vlen=tile([o["vlen"] for _, o in lanes]),
+    )
+    # No walkers: the kernel applies only the put phase.
+    zeros = jnp.zeros((K, 1), jnp.int32)
+    new_slab, _, _, _ = walk_pass_kernel(
+        slab_K,
+        jnp.zeros((K, 3), bool), jnp.zeros((K, 3), jnp.int32),
+        jnp.zeros((K, 3), jnp.int32), jnp.zeros((K, 3, D), jnp.int32),
+        jnp.zeros((K, 3), jnp.int32), jnp.zeros((K, 3), bool),
+        jnp.zeros((K, 3), bool),
+        max_walk=W, out_base=2, out_rows=1, interpret=True,
+        put_ops=put_ops, ev_off=jnp.full((K,), ev_off, jnp.int32),
+    )
+    for i in range(n_distinct):
+        for rep in (0, reps - 1):
+            lane = rep * n_distinct + i
+            got = jax.tree_util.tree_map(lambda x: x[lane], new_slab)
+            assert_slab_equal(seq[i], got, f"lane={lane}")
