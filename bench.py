@@ -165,8 +165,8 @@ def bench_lossfree(K, cycles, reps):
     (``KVSharedVersionedBuffer.java:86-89`` — the reference never drops;
     this line demonstrates the engine fast AND match-identical)."""
     cfg = EngineConfig(
-        max_runs=48, slab_entries=128, slab_preds=8, dewey_depth=12,
-        max_walk=12,
+        max_runs=48, slab_entries=112, slab_preds=8, dewey_depth=10,
+        max_walk=10,
     )
     batch = BatchMatcher(stock_demo.stock_pattern(), K, cfg)
     state0 = batch.init_state()
