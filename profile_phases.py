@@ -35,6 +35,10 @@ rng = np.random.default_rng(0)
 
 
 def mk_slab():
+    # Caveat: this random slab is internally inconsistent (dangling pstage
+    # pointers, refs on free entries), so data-dependent walk trip counts
+    # here understate real load — use profile_ablate.py (ablation inside the
+    # real scan) before optimization decisions; see PROFILE_r04.md.
     i32 = jnp.int32
     n_live = E // 2
     stage = np.full((K, E), -1, np.int32)
@@ -64,6 +68,7 @@ def bench(name, fn, *args):
     ca = comp.cost_analysis()
     if isinstance(ca, list):
         ca = ca[0]
+    ca = ca or {}  # some backends return None — timing still prints
     out = jfn(*args)
     jax.block_until_ready(out)
     best = float("inf")

@@ -107,7 +107,8 @@ def main():
     want = ref_impl(ev, stage, pver)
     err = float(jnp.max(jnp.abs(got - want)))
     print("max abs err:", err)
-    assert err == 0.0, "MISMATCH"
+    # MXU (preferred f32) vs default-precision dot may differ in low bits.
+    assert err < 1e-3, "MISMATCH"
     print("SPIKE OK")
 
 
