@@ -455,7 +455,13 @@ def bench_bank(n_queries, K, T, reps):
         f"({best / bbest:.2f}x serial; fused pays every query's predicates "
         "per lane, so small banks of cheap queries can favor serial)"
     )
-    return max(total / bbest, serial)
+    # Both variants reported — a consumer must not mistake a serial win
+    # for a fused number (or vice versa).
+    return {
+        "serial_qevps": serial,
+        "fused_qevps": total / bbest,
+        "winner": "fused" if bbest < best else "serial",
+    }
 
 
 def bench_sharded_folds(K, T, reps):

@@ -385,7 +385,16 @@ def _build_step(tables, cfg: EngineConfig):
     def eval_preds(key, value, ts, agg_row):
         """ALL queries' predicates against the lane's fold state — each
         query decodes the shared agg row through its own names/dtypes, and
-        its table entries index the merged list via ``pred_base``."""
+        its table entries index the merged list via ``pred_base``.
+
+        Stacked-bank contract: every query's predicates run on every lane,
+        so a lane's agg row is also decoded under *other* queries' dtype
+        conventions; those values are never selected (``pred_base``
+        offsetting keeps each lane on its own query's predicate ids) but
+        the evaluation itself happens.  Predicates must therefore be pure
+        array functions — no side effects, no host callbacks, total over
+        garbage inputs.  jit tracing already enforces the first two; NaN-
+        or overflow-sensitive user code must tolerate off-query rows."""
         vals = []
         for q, t in enumerate(tlist):
             states = ArrayStates(

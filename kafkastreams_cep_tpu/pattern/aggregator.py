@@ -19,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable
 
+import numpy as np
+
 AggregatorFn = Callable[[Any, Any, Any], Any]
 
 
@@ -50,4 +52,13 @@ class StateAggregator:
                     f"'float32', got {self.dtype!r}"
                 )
             return d
-        return "float32" if isinstance(self.init, float) else "int32"
+        kind = np.asarray(self.init).dtype
+        if np.issubdtype(kind, np.floating):
+            return "float32"
+        if np.issubdtype(kind, np.integer) or np.issubdtype(kind, np.bool_):
+            return "int32"
+        raise ValueError(
+            f"fold state {self.name!r}: cannot infer dtype from init "
+            f"{self.init!r} (type {type(self.init).__name__}); pass "
+            f"dtype='int32' or 'float32' explicitly"
+        )

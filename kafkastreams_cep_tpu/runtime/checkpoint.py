@@ -40,7 +40,10 @@ logger = get_logger("runtime.checkpoint")
 # bit patterns) — v1 checkpoints' float32 agg arrays are not translatable
 # without the old dtype convention, so they are refused rather than
 # silently cast.
-FORMAT_VERSION = 2
+# v3: headers record state_dtypes so a fold dtype flip between save and
+# restore is refused; v2 files lack the record and cannot be checked, so
+# they are refused too (same no-silent-reinterpretation rule).
+FORMAT_VERSION = 3
 
 
 def _flatten_state(state: EngineState) -> Dict[str, np.ndarray]:
@@ -88,6 +91,10 @@ def save_checkpoint(
         # Stage names only — the lookup-by-name restore contract.
         "stage_names": list(processor.batch.names),
         "state_names": list(processor.batch.matcher.tables.state_names),
+        # Dtypes travel with the names: agg stores float32 states as int32
+        # bit patterns, so a dtype flip between save and restore would
+        # silently reinterpret bits — refused like a name mismatch.
+        "state_dtypes": list(processor.batch.matcher.tables.state_dtypes),
         "config": dataclasses.asdict(processor.batch.matcher.config),
         "num_lanes": processor.num_lanes,
         "topic": processor.topic,
@@ -166,6 +173,13 @@ def restore_processor(
         )
     if list(proc.batch.matcher.tables.state_names) != list(header["state_names"]):
         raise ValueError("fold-state names do not match checkpoint")
+    proc_dtypes = list(proc.batch.matcher.tables.state_dtypes)
+    if list(header["state_dtypes"]) != proc_dtypes:
+        raise ValueError(
+            "fold-state dtypes do not match checkpoint: "
+            f"{proc_dtypes} vs checkpoint {header['state_dtypes']} "
+            "(typed agg bit patterns are not translatable across dtypes)"
+        )
     proc.state = proc.place(_unflatten_state(proc.state, ckpt["arrays"]))
     proc._lane_of = dict(header["lane_of"])
     proc._key_of = {v: k for k, v in proc._lane_of.items()}

@@ -255,3 +255,28 @@ def test_checkpoint_refuses_wrong_topology(tmp_path):
     save_checkpoint(proc, path)
     with pytest.raises(ValueError, match="topology"):
         restore_processor(sc.skip_till_any(), path)
+
+
+def test_checkpoint_refuses_fold_dtype_flip(tmp_path):
+    """agg stores float32 fold states as int32 bit patterns; restoring
+    under the other dtype convention would silently reinterpret bits, so
+    a dtype flip (init 0 -> 0.0) is refused like a name mismatch."""
+    from kafkastreams_cep_tpu import Query
+
+    def fold_pattern(init):
+        return (
+            Query()
+            .select("a").where(lambda k, v, ts, st: v["x"] > 0)
+            .fold("s", lambda k, v, curr: curr + v["x"], init=init)
+            .then()
+            .select("b").where(lambda k, v, ts, st: v["x"] < 0)
+            .build()
+        )
+
+    proc = CEPProcessor(fold_pattern(0), 1, sc.default_config())
+    proc.process([Record("k", {"x": 1}, 1)])
+    path = str(tmp_path / "ckpt.bin")
+    save_checkpoint(proc, path)
+    with pytest.raises(ValueError, match="dtypes"):
+        restore_processor(fold_pattern(0.0), path)
+    restore_processor(fold_pattern(0), path)  # same dtype restores fine

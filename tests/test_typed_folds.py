@@ -106,3 +106,16 @@ def test_explicit_dtype_overrides_init_inference():
     assert agg.resolved_dtype == "float32"
     with pytest.raises(ValueError, match="dtype"):
         StateAggregator("s", lambda k, v, c: c, dtype="int64").resolved_dtype
+
+
+def test_numpy_scalar_init_infers_dtype():
+    """np.float32(0.5) is not a Python float — inference must still see a
+    float (int32 inference would truncate the init to 0 silently)."""
+    f = StateAggregator("s", lambda k, v, c: c, init=np.float32(0.5))
+    assert f.resolved_dtype == "float32"
+    i = StateAggregator("s", lambda k, v, c: c, init=np.int64(3))
+    assert i.resolved_dtype == "int32"
+    b = StateAggregator("s", lambda k, v, c: c, init=np.bool_(True))
+    assert b.resolved_dtype == "int32"
+    with pytest.raises(ValueError, match="infer"):
+        StateAggregator("s", lambda k, v, c: c, init="zero").resolved_dtype
