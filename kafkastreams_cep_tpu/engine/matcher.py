@@ -125,8 +125,11 @@ class EngineConfig:
     # Wider batches run walkers of a batch in lockstep — near-sequential and
     # faster when many walkers fire, but when two removal walkers meet at
     # one entry in the same hop, prune/delete attribution can deviate from
-    # sequential (a refs==0 entry may survive with a stale pointer).  The
-    # fused Pallas kernel path is always sequential-exact regardless.
+    # sequential (a refs==0 entry may survive with a stale pointer).  That
+    # trigger is counted per occurrence in the ``walk_collisions`` counter:
+    # a run whose walk_collisions stays 0 matched sequential order exactly;
+    # nonzero means the match set may have diverged.  The fused Pallas
+    # kernel path is always sequential-exact (and collision-free) regardless.
     walker_budget: int = 1
     enforce_windows: bool = False  # deviation: functional within() pruning
     # Apply slab ops one run at a time (the reference's literal op order)
@@ -218,6 +221,7 @@ COUNTER_NAMES = (
     "slab_pred_drops",
     "slab_missing",
     "slab_trunc",
+    "walk_collisions",
 )
 
 
@@ -230,6 +234,7 @@ def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
         state.slab.pred_drops,
         state.slab.missing,
         state.slab.trunc,
+        state.slab.collisions,
     )
 
 

@@ -69,6 +69,12 @@ class SlabState(NamedTuple):
     pred_drops: jnp.ndarray  # scalar int32 — pointer-list overflow drops
     missing: jnp.ndarray  # scalar int32 — lookups the reference would NPE on
     trunc: jnp.ndarray  # scalar int32 — walks cut short by the walk bound
+    collisions: jnp.ndarray  # scalar int32 — same-entry same-hop meetings of
+    #   two lockstep remove-walkers: the exact trigger for prune/delete
+    #   attribution deviating from the reference's sequential order.  Always
+    #   0 on the default paths (walker_budget=1 runs walkers alone; the
+    #   Pallas kernel is sequential by construction); nonzero means a
+    #   walker_budget>1 run may have diverged (see EngineConfig).
 
 
 def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
@@ -87,6 +93,7 @@ def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
         pred_drops=jnp.zeros((), dtype=i32),
         missing=jnp.zeros((), dtype=i32),
         trunc=jnp.zeros((), dtype=i32),
+        collisions=jnp.zeros((), dtype=i32),
     )
 
 
@@ -475,6 +482,14 @@ def walks_batched(
         e = jnp.argmax(hit, axis=1)
         last = arm & ~jnp.any(
             (e[None, :] == e[:, None]) & later & arm[None, :], axis=1
+        )
+        # Two remove-walkers at one entry in one hop is the exact condition
+        # under which last-walker attribution can deviate from sequential
+        # order — count every extra walker so the deviation is observable
+        # (EngineConfig.walker_budget; 0 by construction at budget=1).
+        n_rm = jnp.sum((ham & is_remove[:, None]).astype(i32), axis=0)
+        slab = slab._replace(
+            collisions=slab.collisions + jnp.sum(jnp.maximum(n_rm - 1, 0))
         )
 
         # Row extraction stays a one-hot matmul over the full packed slab:

@@ -295,6 +295,48 @@ def test_walks_batched_mixed_matches_sequential(seed):
         np.testing.assert_array_equal(np.asarray(b_of[PB + p]), of, f"walker {p}")
 
 
+def test_walk_collisions_counted_in_lockstep_only():
+    """Two remove-walkers meeting at one entry in one hop is the exact
+    trigger for lockstep prune/delete attribution deviating from the
+    sequential order; the ``collisions`` counter must record it in the
+    lockstep pass and stay zero when walkers run alone (budget=1, the
+    engine default)."""
+    rng = np.random.default_rng(7)
+    v11, l11 = mkver(1, 1)
+    P = 2
+    stage = jnp.asarray([2, 2], jnp.int32)
+    off = jnp.asarray([3, 3], jnp.int32)
+    en = jnp.asarray([True, True])
+    ver = jnp.stack([v11, v11])
+    vlen = jnp.stack([l11, l11])
+    ones = jnp.ones((P,), bool)
+
+    def fresh():
+        slab = seed_slab(rng)
+        # Refcount invariant: the second lineage branched onto the chain.
+        return slab_mod.branch(slab, stage[1], off[1], ver[1], vlen[1], W)
+
+    bat, _, _, _ = slab_mod.walks_batched(
+        fresh(), en, stage, off, ver, vlen,
+        is_remove=ones, want_out=ones, max_walk=W,
+    )
+    assert int(bat.collisions) > 0, "lockstep meeting not counted"
+
+    solo, _, _, _ = slab_mod.walks_compacted(
+        fresh(), en, stage, off, ver, vlen,
+        is_remove=ones, want_out=ones, max_walk=W,
+        budget=1, out_base=0, out_rows=P,
+    )
+    assert int(solo.collisions) == 0, "budget=1 must be collision-free"
+
+    wide, _, _, _ = slab_mod.walks_compacted(
+        fresh(), en, stage, off, ver, vlen,
+        is_remove=ones, want_out=ones, max_walk=W,
+        budget=2, out_base=0, out_rows=P,
+    )
+    assert int(wide.collisions) > 0, "budget=2 same-entry meeting not counted"
+
+
 def test_ab_engine_paths():
     """Engine-level A/B: sequential_slab True vs False on a branching-heavy
     skip-till-any trace must produce identical matches and counters."""
