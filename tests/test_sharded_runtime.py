@@ -183,3 +183,61 @@ def test_sharded_walk_kernel_interpret_parity(mesh8, monkeypatch):
     for b in batches(recs, size=64):
         assert fmt(krn_proc.process(b)) == fmt(jnp_proc.process(b))
     assert krn_proc.counters() == jnp_proc.counters()
+
+
+def test_sharded_scan_exact_stats_and_outputs(mesh8):
+    """The semantic cover for ``check_vma=False`` (parallel/sharding.py):
+    shard_map's static replication analysis is disabled at every site, so
+    a misplaced collective would pass compilation — this test would catch
+    it instead.  On a per-lane-distinct, counter-heavy kleene trace, the
+    sharded scan's match outputs and psum'd stats must EXACTLY equal the
+    single-device BatchMatcher run (not merely >= some floor)."""
+    import jax.numpy as jnp
+
+    from kafkastreams_cep_tpu.engine import EventBatch
+    from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+    from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher
+
+    def kleene():
+        return (
+            Query()
+            .select("a").where(lambda k, v, ts, st: v["x"] == 0)
+            .then()
+            .select("b").one_or_more().skip_till_any_match()
+            .where(lambda k, v, ts, st: (0 < v["x"]) & (v["x"] < 8))
+            .then()
+            .select("c").where(lambda k, v, ts, st: v["x"] >= 8)
+            .build()
+        )
+
+    K, T = 16, 48
+    rng = np.random.default_rng(11)
+    # Per-lane-distinct activity: lane L sees its own random stream, and
+    # the tiny config overflows differently per lane (runs, slab, preds),
+    # so any cross-shard mixup or double-count changes the totals.
+    xs = rng.integers(0, 10, size=(K, T)).astype(np.int32)
+    events = EventBatch(
+        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
+        value={"x": jnp.asarray(xs)},
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+
+    batch = BatchMatcher(kleene(), K, CFG)
+    bstate, bout = batch.scan(batch.init_state(), events)
+    ref_counters = batch.counters(bstate)
+    # The trace must actually exercise the counters for the equality to
+    # mean anything.
+    assert sum(ref_counters.values()) > 0, ref_counters
+
+    sharded = ShardedMatcher(kleene(), K, mesh8, CFG)
+    sstate, sout = sharded.scan(
+        sharded.init_state(), sharded.shard_events(events)
+    )
+    np.testing.assert_array_equal(np.asarray(sout.count), np.asarray(bout.count))
+    np.testing.assert_array_equal(np.asarray(sout.stage), np.asarray(bout.stage))
+    np.testing.assert_array_equal(np.asarray(sout.off), np.asarray(bout.off))
+    expect = dict(ref_counters)
+    expect["alive_runs"] = int(jnp.sum(bstate.alive))
+    assert sharded.stats(sstate) == expect
