@@ -131,6 +131,15 @@ class EngineConfig:
     # nonzero means the match set may have diverged.  The fused Pallas
     # kernel path is always sequential-exact (and collision-free) regardless.
     walker_budget: int = 1
+    # Delete provably-dead zero positions from all versions in a lane at
+    # sweep time (ops/renorm.py) — keeps the fixed dewey_depth sufficient
+    # on unbounded streams whose straddling runs append a digit per event
+    # (NFA.java:185-188).  Semantics-preserving by construction; the switch
+    # exists for differential testing.  Only effective when sweeps actually
+    # run: BatchMatcher/ShardedMatcher ``sweep()`` between scans, which
+    # ``CEPProcessor`` schedules every ``gc_interval`` batches (on by
+    # default there); bare ``MatcherSession`` never sweeps.
+    renorm_versions: bool = True
     enforce_windows: bool = False  # deviation: functional within() pruning
     # Apply slab ops one run at a time (the reference's literal op order)
     # instead of the batched per-step passes.  The batched path reproduces

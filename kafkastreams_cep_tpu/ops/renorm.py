@@ -1,0 +1,149 @@
+"""Dewey version renormalization — bounded-width versions on unbounded streams.
+
+The reference's versions grow without bound: every event that a run spends
+straddling a stage boundary appends a ``.0`` component (``NFA.java:185-188``
+via ``DeweyVersion.java:84-86``), so version length is O(events in the run's
+lifetime) — see the oracle reproducing ``1.0.0.0...`` growth on the stock
+pattern.  The reference can afford that (heap tuples); the array engine's
+fixed ``[D]`` width cannot, and at overflow the digit is dropped and counted
+(``ops/dewey_ops.py:add_stage``).
+
+This module removes the growth instead of widening ``D``: between scan
+steps, interior positions provably equal to ``0`` in *every* version that
+crosses them are deleted from all versions in the lane at once.  Deleting
+position ``k`` is **semantics-preserving** — every ``is_compatible(q, p)``
+outcome, for all current versions and all versions derivable from current
+run versions by future ``add_stage``/``add_run`` chains, is unchanged —
+when all of:
+
+1. every live pointer version ``p`` has ``len(p) <= k``, or
+   ``p[k] == 0 and len(p) >= k + 2``;
+2. every alive non-seed run version crosses with slack:
+   ``len(v) >= k + 2 and v[k] == 0`` (a run *ending* at ``k`` or short of it
+   could later grow fresh digits across ``k`` and misalign against the
+   shifted pointers — seen in the worked counterexamples in the proof note
+   below);
+3. alive seed runs (``id_pos < 0`` — fresh counter version, nothing
+   consumed, no buffer footprint) are exempt from (2) but no crossing
+   version may share their first digit (their descendants are then
+   digit-0-incompatible with every shifted version, before and after).
+
+Proof sketch (pairwise, per position; simultaneous deletion composes by
+induction on descending ``k``): pairs both crossing ``k`` shift together —
+digit comparisons below ``k`` unchanged, the deleted digits are equal
+(``0 == 0``), digits above shift equally, and neither last digit moves
+relative to its version (``len >= k+2`` keeps the last digit off ``k``);
+pairs where only the longer version crosses preserve strict length
+inequality because ``len >= k+2`` keeps the shrunken length ``>= k+1 >
+k >= len(short)``; the ``len == k+1`` exclusion is what forbids a shrink
+onto *equal* length, where the last-digit ``>=`` rule could flip a verdict
+(e.g. ``q=1.0.3`` deleting ``k=1`` against a sibling pointer ``p=1.5``).
+
+The deletable positions are exactly where unbounded growth happens (the
+appended zero runs), so a sweep cadence that outpaces per-batch growth
+keeps ``D`` bounded for arbitrarily long streams — with ``ver_overflows``
+still counting any trace that outruns it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def safe_positions(
+    run_ver, run_vlen, run_alive, run_seed, pver, pvlen, ptr_live
+):
+    """The ``[D]`` bool mask of deletable positions for one lane.
+
+    ``run_ver [R, D]``, ``run_vlen [R]``, ``run_alive [R]``, ``run_seed
+    [R]`` (alive & never-consumed), ``pver [N, D]``, ``pvlen [N]``,
+    ``ptr_live [N]`` (entry live & slot < npreds).
+    """
+    i32 = jnp.int32
+    D = run_ver.shape[1]
+    idx = jnp.arange(D, dtype=i32)  # position axis
+
+    nonseed = run_alive & ~run_seed
+
+    def cross_ok(ver, vlen, mask):
+        # For versions in ``mask`` crossing k: digit 0 at k and len >= k+2.
+        crossing = mask[:, None] & (vlen[:, None] > idx[None, :])
+        ok = (ver == 0) & (vlen[:, None] >= idx[None, :] + 2)
+        return ~jnp.any(crossing & ~ok, axis=0)  # [D]
+
+    # (2): non-seed runs must ALL cross with slack (a short non-seed run
+    # blocks every k at or beyond its length).
+    run_short = nonseed[:, None] & (run_vlen[:, None] <= idx[None, :])
+    run_ok = cross_ok(run_ver, run_vlen, nonseed) & ~jnp.any(run_short, axis=0)
+
+    # (1): pointers either don't reach k or cross with slack.
+    ptr_ok = cross_ok(pver, pvlen, ptr_live)
+
+    # (3): no crossing version shares a seed's first digit.
+    cross_run = run_alive[:, None] & (run_vlen[:, None] > idx[None, :])
+    cross_ptr = ptr_live[:, None] & (pvlen[:, None] > idx[None, :])
+    seed_d0 = run_ver[:, 0]  # [R]
+    clash_run = jnp.any(
+        run_seed[:, None, None]
+        & cross_run[None, :, :]
+        & (seed_d0[:, None, None] == run_ver[None, :, 0:1]),
+        axis=(0, 1),
+    )
+    clash_ptr = jnp.any(
+        run_seed[:, None, None]
+        & cross_ptr[None, :, :]
+        & (seed_d0[:, None, None] == pver[None, :, 0:1]),
+        axis=(0, 1),
+    )
+    return run_ok & ptr_ok & ~clash_run & ~clash_ptr  # [D]
+
+
+def delete_positions(ver, vlen, safe):
+    """Stable-compact ``safe`` positions out of ``ver [..., D]``.
+
+    Positions ``k`` with ``safe[k] and k < vlen`` are removed; later digits
+    shift down, the tail zero-fills, ``vlen`` shrinks by the removed count.
+    """
+    i32 = jnp.int32
+    D = ver.shape[-1]
+    idx = jnp.arange(D, dtype=i32)
+    shape1 = (1,) * (ver.ndim - 1)
+    inside = idx.reshape(shape1 + (D,)) < vlen[..., None]
+    drop = safe.reshape(shape1 + (D,)) & inside
+    keep = ~drop
+    tgt = jnp.cumsum(keep.astype(i32), axis=-1) - 1
+    perm = keep[..., None] & (idx.reshape(shape1 + (1, D)) == tgt[..., None])
+    new_ver = jnp.sum(
+        jnp.where(perm, ver[..., None], 0), axis=-2
+    ).astype(ver.dtype)
+    new_vlen = (vlen - jnp.sum(drop, axis=-1)).astype(vlen.dtype)
+    return new_ver, new_vlen
+
+
+def renorm_lane(run_ver, run_vlen, alive, id_pos, slab):
+    """Renormalize one lane's run + pointer versions; returns
+    ``(run_ver, run_vlen, slab, n_deleted)``."""
+    E, MP, D = slab.pver.shape
+    seed = alive & (id_pos < 0)
+    live_entry = slab.stage >= 0
+    slot_live = live_entry[:, None] & (
+        jnp.arange(MP, dtype=jnp.int32)[None, :] < slab.npreds[:, None]
+    )
+    pv = slab.pver.reshape(E * MP, D)
+    pl = slab.pvlen.reshape(E * MP)
+    safe = safe_positions(
+        run_ver, run_vlen, alive, seed, pv, pl, slot_live.reshape(E * MP)
+    )
+    new_rv, new_rl = delete_positions(run_ver, run_vlen, safe)
+    new_pv, new_pl = delete_positions(
+        slab.pver, slab.pvlen, safe
+    )
+    # Only live rows move; dead/garbage rows stay byte-identical so
+    # differential tests against the un-renormalized path stay sharp.
+    run_m = alive
+    rv = jnp.where(run_m[:, None], new_rv, run_ver)
+    rl = jnp.where(run_m, new_rl, run_vlen)
+    pvo = jnp.where(slot_live[:, :, None], new_pv, slab.pver)
+    plo = jnp.where(slot_live, new_pl, slab.pvlen)
+    slab = slab._replace(pver=pvo, pvlen=plo)
+    return rv, rl, slab, jnp.sum(safe.astype(jnp.int32))

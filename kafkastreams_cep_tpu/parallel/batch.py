@@ -118,6 +118,29 @@ def kernel_lane_scan(step):
     return scan
 
 
+def sweep_lanes(state: EngineState, depth: int, do_renorm: bool) -> EngineState:
+    """Per-lane maintenance sweep shared by the batch and sharded matchers
+    (single source, like :func:`lane_step`, so their sweep semantics can
+    never diverge): slab mark-sweep (frees entries unreachable from live
+    run state) then, when enabled, Dewey version renormalization
+    (``ops/renorm.py`` — deletes provably-dead zero positions so the fixed
+    ``dewey_depth`` stays sufficient on unbounded straddling streams)."""
+    from kafkastreams_cep_tpu.ops import renorm as renorm_mod
+    from kafkastreams_cep_tpu.ops import slab as slab_mod
+
+    run_off = jnp.where(state.alive, state.event_off, -1)
+    slab = jax.vmap(
+        lambda s, ro: slab_mod.mark_sweep(s, None, ro, depth)
+    )(state.slab, run_off)
+    state = state._replace(slab=slab)
+    if do_renorm:
+        ver, vlen, slab, _ = jax.vmap(renorm_mod.renorm_lane)(
+            state.ver, state.vlen, state.alive, state.id_pos, state.slab
+        )
+        state = state._replace(ver=ver, vlen=vlen, slab=slab)
+    return state
+
+
 def _select_walk_kernel(config: EngineConfig, num_lanes: int):
     """Decide (use_kernel, interpret) for this batch shape.
 
@@ -198,19 +221,9 @@ class BatchMatcher:
 
     @functools.cached_property
     def _sweep_jit(self):
-        from kafkastreams_cep_tpu.ops import slab as slab_mod
-
         depth = self.matcher.config.max_walk
-
-        @jax.jit
-        def run(state: EngineState) -> EngineState:
-            run_off = jnp.where(state.alive, state.event_off, -1)
-            slab = jax.vmap(
-                lambda s, ro: slab_mod.mark_sweep(s, None, ro, depth)
-            )(state.slab, run_off)
-            return state._replace(slab=slab)
-
-        return run
+        do_renorm = self.matcher.config.renorm_versions
+        return jax.jit(lambda state: sweep_lanes(state, depth, do_renorm))
 
     def counters(self, state: EngineState) -> Dict[str, int]:
         """Aggregate overflow/drop counters summed over all lanes."""

@@ -139,16 +139,13 @@ class ShardedMatcher:
 
     @functools.cached_property
     def _sweep_jit(self):
-        from kafkastreams_cep_tpu.ops import slab as slab_mod
+        from kafkastreams_cep_tpu.parallel.batch import sweep_lanes
 
         depth = self.matcher.config.max_walk
+        do_renorm = self.matcher.config.renorm_versions
 
         def local(state: EngineState) -> EngineState:
-            run_off = jnp.where(state.alive, state.event_off, -1)
-            slab = jax.vmap(
-                lambda s, ro: slab_mod.mark_sweep(s, None, ro, depth)
-            )(state.slab, run_off)
-            return state._replace(slab=slab)
+            return sweep_lanes(state, depth, do_renorm)
 
         spec = P(self.axis)
         return jax.jit(

@@ -111,7 +111,7 @@ class CEPProcessor:
         epoch: Optional[int] = None,
         gc_events: bool = True,
         dedup: bool = True,
-        gc_interval: int = 0,
+        gc_interval: int = 16,
         gc_events_interval: int = 8,
         mesh=None,
     ):
@@ -130,10 +130,14 @@ class CEPProcessor:
             self.batch = BatchMatcher(pattern, num_lanes, config)
         self.topic = topic
         self.num_lanes = int(num_lanes)
-        # Slab mark-sweep every N batches (0 = off).  Long streams strand
+        # Maintenance sweep every N batches (0 = off; on by default —
+        # unbounded streams need it twice over).  Long streams strand
         # walk-bound-truncated paths in the slab (counted in ``trunc``);
         # the sweep frees entries no future buffer op can reach, holding
-        # occupancy bounded at fixed slab_entries.
+        # occupancy bounded at fixed slab_entries.  The same sweep also
+        # renormalizes Dewey versions (EngineConfig.renorm_versions) so
+        # straddling runs' per-event version growth (NFA.java:185-188)
+        # doesn't exhaust the fixed dewey_depth.
         self.gc_interval = int(gc_interval)
         # Host-event GC cadence: _gc_events costs a full device_get of slab
         # keys + run state; amortizing it every N batches keeps the host

@@ -1,0 +1,268 @@
+"""Version renormalization (``ops/renorm.py``) — bounded-width Dewey
+versions on unbounded streams.
+
+The reference's versions grow one ``.0`` per straddling event
+(``NFA.java:185-188``); the fixed-width engine counts overflows instead
+(``ops/dewey_ops.py``).  Renormalization deletes provably-dead zero
+positions at sweep time.  Pinned here:
+
+* the compaction primitive and every blocker of the safety condition;
+* all-pairs ``is_compatible`` preservation, including versions *derived*
+  from post-renorm run versions by future add_stage/add_run chains;
+* the engine-level contract: a straddle-heavy stream swept between
+  micro-batches stays overflow-free at a dewey_depth that overflows
+  without renorm, with outputs identical to a wide-depth reference run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import Query
+from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.ops import dewey_ops
+from kafkastreams_cep_tpu.ops import renorm
+from kafkastreams_cep_tpu.ops import slab as slab_mod
+from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+
+D = 10
+
+
+def ver(*comps):
+    v, l = dewey_ops.make(comps, D)
+    return jnp.asarray(v), jnp.asarray(l)
+
+
+def pack(versions):
+    vs, ls = zip(*[ver(*c) for c in versions])
+    return jnp.stack(vs), jnp.stack(ls)
+
+
+def test_delete_positions_compacts_and_zero_fills():
+    v, l = pack([(1, 0, 0, 3, 0), (2, 0, 5)])
+    safe = jnp.asarray([False, True, False, False, True] + [False] * (D - 5))
+    nv, nl = renorm.delete_positions(v, l, safe)
+    assert nl.tolist() == [3, 2]
+    assert nv[0, :4].tolist() == [1, 0, 3, 0]
+    assert nv[1, :3].tolist() == [2, 5, 0]
+    # Tail stays zero (add_stage relies on it).
+    assert not nv[:, 4:].any()
+
+
+def empty_slab():
+    return slab_mod.make(8, 4, D)
+
+
+def slab_with(versions):
+    """A slab whose live pointer slots carry ``versions`` (one entry each)."""
+    slab = empty_slab()
+    for i, comps in enumerate(versions):
+        v, l = ver(*comps)
+        slab = slab_mod.put_first(slab, i, i, v, l)
+    return slab
+
+
+def lane(run_versions, ptr_versions, seeds=()):
+    """(run_ver, run_vlen, alive, id_pos, slab) for a crafted lane."""
+    rv, rl = pack(list(run_versions) + [(9,)] * 0)
+    R = rv.shape[0]
+    alive = jnp.ones((R,), bool)
+    id_pos = jnp.asarray(
+        [-1 if i in seeds else 1 for i in range(R)], jnp.int32
+    )
+    return rv, rl, alive, id_pos, slab_with(ptr_versions)
+
+
+def all_pairs_compat(run_vers, ptr_vers):
+    out = []
+    for q, ql in zip(*run_vers):
+        for p, pl in zip(*ptr_vers):
+            out.append(bool(dewey_ops.is_compatible(q, ql, p, pl)))
+    return out
+
+
+def test_safe_positions_finds_zero_runs():
+    rv, rl, alive, idp, slab = lane(
+        [(1, 0, 0, 0, 0, 0), (7,)], [(1,), (1, 0, 0, 0, 0)], seeds={1}
+    )
+    nrv, nrl, nslab, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+    # Positions 1..2 are deletable (both crossers have zeros with slack);
+    # position 3 is blocked by the pointer ending at length 5 (== k+2-1?
+    # no: len 5 >= 3+2 passes) — compute: deletable k where every crosser
+    # has 0 at k and len >= k+2: run len 6, ptr len 5 -> k in {1, 2, 3}.
+    assert int(n) == 3
+    assert nrl.tolist() == [3, 1]
+    assert nrl[0] == 3 and nrv[0, :3].tolist() == [1, 0, 0]
+
+
+def test_blockers_leave_versions_untouched():
+    # (a) a pointer ENDING just past k (len == k+1) blocks k — the sibling
+    # last-digit counterexample in ops/renorm.py's proof note.
+    rv, rl, alive, idp, slab = lane(
+        [(1, 0, 0, 0, 0)], [(1,), (1, 5)], seeds=set()
+    )
+    _, nrl, _, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+    assert int(n) == 2  # k=2,3 deletable; k=1 blocked by (1,5) ending there
+    # (a') a short non-seed RUN blocks even harder (fresh regrowth hazard).
+    rv, rl, alive, idp, slab = lane(
+        [(1, 0, 0, 0, 0), (1, 5)], [(1,)], seeds=set()
+    )
+    _, _, _, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+    assert int(n) == 0
+    # (b) a nonzero digit blocks its position.
+    rv, rl, alive, idp, slab = lane(
+        [(1, 0, 2, 0, 0, 0)], [(1,)], seeds=set()
+    )
+    _, nrl, _, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+    assert int(n) == 3  # k in {1, 3, 4}; k=2 blocked by digit 2
+    # (c) a short non-seed run blocks everything at/past its length.
+    rv, rl, alive, idp, slab = lane(
+        [(1, 0, 0, 0, 0, 0), (2, 0, 0)], [(1,)], seeds=set()
+    )
+    _, _, _, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+    assert int(n) == 1  # only k=1 (both runs zero there with slack)
+    # (d) a seed sharing a crossing version's first digit blocks.
+    rv, rl, alive, idp, slab = lane(
+        [(1, 0, 0, 0, 0, 0), (1,)], [(1,)], seeds={1}
+    )
+    _, _, _, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+    assert int(n) == 0
+    # ... but a fresh-digit seed doesn't.
+    rv, rl, alive, idp, slab = lane(
+        [(1, 0, 0, 0, 0, 0), (4,)], [(1,)], seeds={1}
+    )
+    _, _, _, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+    assert int(n) > 0
+
+
+def random_growth(rng, depth_cap):
+    """A version grown the way the engine grows them: start (d0,), then a
+    random add_stage / add_run chain."""
+    comps = [int(rng.integers(1, 4))]
+    for _ in range(int(rng.integers(0, depth_cap - 1))):
+        if rng.random() < 0.75:
+            comps.append(0)  # add_stage
+        else:
+            comps[-1] += 1  # add_run
+    return tuple(comps)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_renorm_preserves_all_pairs_compat_including_futures(seed):
+    rng = np.random.default_rng(seed)
+    runs = [random_growth(rng, D - 2) for _ in range(4)]
+    ptrs = [random_growth(rng, D - 2) for _ in range(6)]
+    rv, rl, alive, idp, slab = lane(runs, ptrs, seeds=set())
+    nrv, nrl, nslab, n = renorm.renorm_lane(rv, rl, alive, idp, slab)
+
+    MP = slab.pstage.shape[1]
+    old_p = (slab.pver.reshape(-1, D)[::MP][: len(ptrs)],
+             slab.pvlen.reshape(-1)[::MP][: len(ptrs)])
+    new_p = (nslab.pver.reshape(-1, D)[::MP][: len(ptrs)],
+             nslab.pvlen.reshape(-1)[::MP][: len(ptrs)])
+    assert all_pairs_compat((rv, rl), old_p) == all_pairs_compat(
+        (nrv, nrl), new_p
+    ), f"seed={seed} current-pairs compat changed"
+
+    # Future-derived versions: the same op chain applied pre and post
+    # renorm must agree against every (pre/post) pointer.
+    for r in range(len(runs)):
+        ops = [rng.random() < 0.6 for _ in range(3)]
+        qo, qol = rv[r], rl[r]
+        qn, qnl = nrv[r], nrl[r]
+        for is_stage in ops:
+            if is_stage:
+                qo, qol, _ = dewey_ops.add_stage(qo, qol)
+                qn, qnl, _ = dewey_ops.add_stage(qn, qnl)
+            else:
+                qo = dewey_ops.add_run(qo, qol)
+                qn = dewey_ops.add_run(qn, qnl)
+        for p in range(len(ptrs)):
+            got_o = bool(dewey_ops.is_compatible(
+                qo, qol, old_p[0][p], old_p[1][p]))
+            got_n = bool(dewey_ops.is_compatible(
+                qn, qnl, new_p[0][p], new_p[1][p]))
+            assert got_o == got_n, (
+                f"seed={seed} run {r} future chain vs ptr {p}: "
+                f"{got_o} -> {got_n}"
+            )
+
+
+def straddle_pattern():
+    """Stock-shaped: zero_or_more makes BEGIN-advanced runs straddle and
+    append a version digit per ignored event (the oracle reproduces the
+    same ``1.0.0...`` growth — see ops/renorm.py)."""
+    return (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] == 0)
+        .then()
+        .select("b").zero_or_more().skip_till_next_match()
+        .where(lambda k, v, ts, st: (0 < v["x"]) & (v["x"] < 6))
+        .then()
+        .select("c").skip_till_next_match()
+        .where(lambda k, v, ts, st: v["x"] == 7)
+        .build()
+    )
+
+
+def chunked_scan(cfg, xs, chunk):
+    K, T = xs.shape
+    batch = BatchMatcher(straddle_pattern(), K, cfg)
+    state = batch.init_state()
+    outs = []
+    for t0 in range(0, T, chunk):
+        sl = xs[:, t0:t0 + chunk]
+        events = EventBatch(
+            key=jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None], sl.shape),
+            value={"x": jnp.asarray(sl)},
+            ts=jnp.asarray(
+                np.broadcast_to(np.arange(t0, t0 + sl.shape[1]),
+                                sl.shape).astype(np.int32)),
+            off=jnp.asarray(
+                np.broadcast_to(np.arange(t0, t0 + sl.shape[1]),
+                                sl.shape).astype(np.int32)),
+            valid=jnp.ones(sl.shape, bool),
+        )
+        state, out = batch.scan(state, events)
+        outs.append(jax.tree_util.tree_map(np.asarray, out))
+        state = batch.sweep(state)
+    return outs, batch.counters(state)
+
+
+def test_long_stream_stays_overflow_free_with_renorm():
+    """64 straddle-heavy events, swept every 8: dewey_depth=16 overflows
+    WITHOUT renorm and stays overflow-free WITH it, and the renormalized
+    run's outputs equal a wide-depth (D=80) reference run event-for-event."""
+    # Growth happens while a BEGIN-advanced run straddles with zero takes
+    # (1.0 -> 1.0.0 -> ... per ignored event, confirmed against the oracle);
+    # 40 straddling events overflow D=12 sixfold without renorm, then the
+    # take/complete tail exercises walks over the renormalized versions.
+    base = [0] + [6] * 40 + [1, 6, 7] + [0] + [6] * 12 + [1, 7] + [6] * 5
+    K, T = 4, len(base)
+    xs = np.stack(
+        [np.roll(np.asarray(base, np.int32), k) for k in range(K)]
+    )
+    xs[:, 0] = 0  # every lane opens with a begin event
+    # Slim depth must cover per-chunk growth (8) plus the post-sweep
+    # residual: concurrent straddlers keep their start-offset spread
+    # (deletable positions stop at the shortest crossing version), and the
+    # rolled lanes run two lineages ~3 events apart -> residual ~5.
+    args = dict(max_runs=8, slab_entries=32, slab_preds=4, max_walk=16)
+    wide = EngineConfig(dewey_depth=80, **args)
+    slim = EngineConfig(dewey_depth=16, **args)
+    slim_off = EngineConfig(
+        dewey_depth=16, renorm_versions=False, **args)
+
+    outs_ref, c_ref = chunked_scan(wide, xs, chunk=8)
+    assert c_ref["ver_overflows"] == 0
+    outs_off, c_off = chunked_scan(slim_off, xs, chunk=8)
+    assert c_off["ver_overflows"] > 0, "trace must overflow without renorm"
+    outs_on, c_on = chunked_scan(slim, xs, chunk=8)
+    assert c_on["ver_overflows"] == 0, c_on
+
+    for got, want in zip(outs_on, outs_ref):
+        np.testing.assert_array_equal(got.count, want.count)
+        np.testing.assert_array_equal(got.off, want.off)
+        np.testing.assert_array_equal(got.stage, want.stage)
