@@ -10,6 +10,12 @@ from kafkastreams_cep_tpu.engine.matcher import (
     StepOutput,
     TPUMatcher,
 )
+from kafkastreams_cep_tpu.engine.sizing import (
+    ProbeReport,
+    autosize,
+    probe,
+    suggest,
+)
 from kafkastreams_cep_tpu.engine.stencil import (
     StencilMatcher,
     StencilOutput,
@@ -22,9 +28,13 @@ __all__ = [
     "EngineState",
     "EventBatch",
     "MatcherSession",
+    "ProbeReport",
     "StencilMatcher",
     "StencilOutput",
     "StencilState",
     "StepOutput",
     "TPUMatcher",
+    "autosize",
+    "probe",
+    "suggest",
 ]

@@ -1,0 +1,213 @@
+"""Capacity estimation — derive an ``EngineConfig`` instead of hand-tuning.
+
+The reference needs no sizing: its run queue, shared buffer, and versions
+are heap-backed and unbounded (``NFA.java:75``, ``CEPProcessor.java:
+144-149``).  The array engine's shapes are static, so every dimension is a
+capacity knob with an overflow counter.  This module closes the gap the
+way a profiler would: run the real pattern over a *sample* of the real
+traffic with instrumented occupancy maxima, then derive a config with
+headroom — growing any dimension whose counter fires and tightening the
+rest.
+
+``probe``    — one instrumented run: counters + occupancy maxima.
+``suggest``  — a config from a probe report (structural floors from the
+               compiled tables + measured maxima x margin).
+``autosize`` — the closed loop: probe, grow what overflowed, re-probe,
+               then tighten.  The returned config is verified loss-free
+               on the sample (capacity counters zero; ``slab_missing``
+               is excluded — with every capacity counter zero it marks
+               reference-NPE trace states, a pattern property the
+               reference would crash on, not a sizing defect).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafkastreams_cep_tpu.engine.matcher import (
+    EngineConfig,
+    EventBatch,
+)
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("engine.sizing")
+
+# Counters that indicate a capacity knob is too small, with the knob they
+# grow.  slab_missing is deliberately absent (see module docstring);
+# walk_collisions is a semantics flag, not a capacity.
+_COUNTER_KNOB = {
+    "run_drops": "max_runs",
+    "ver_overflows": "dewey_depth",
+    "slab_full_drops": "slab_entries",
+    "slab_pred_drops": "slab_preds",
+    "slab_trunc": "max_walk",
+}
+
+
+class ProbeReport(NamedTuple):
+    """What one instrumented sample run observed."""
+
+    counters: Dict[str, int]
+    max_alive_runs: int  # per lane, max over chunk boundaries
+    max_live_entries: int  # slab entries in use, per lane
+    max_npreds: int  # pointer-list width in use
+    max_vlen: int  # deepest Dewey version (runs and pointers)
+    max_match_len: int  # longest extracted match
+    config: EngineConfig
+
+
+def _chunked(events: EventBatch, chunk: int):
+    T = int(events.ts.shape[1])
+    for t0 in range(0, T, chunk):
+        yield jax.tree_util.tree_map(
+            lambda x: x[:, t0:t0 + chunk], events
+        )
+
+
+def probe(
+    pattern,
+    events: EventBatch,
+    config: EngineConfig,
+    sweep_every: int = 16,
+) -> ProbeReport:
+    """Run ``pattern`` over ``events [K, T]`` under ``config``, sweeping
+    every ``sweep_every`` events (match the deployment's cadence: the
+    processor sweeps every ``gc_interval`` micro-batches), and record
+    occupancy maxima.
+
+    Maxima are sampled at chunk boundaries; within-chunk peaks are covered
+    by the growth loop in :func:`autosize` (a dimension that only peaks
+    intra-chunk still fires its counter and grows).
+    """
+    from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+
+    K = int(events.ts.shape[0])
+    batch = BatchMatcher(pattern, K, config)
+    state = batch.init_state()
+    chunk = max(int(sweep_every), 1)
+    mx = dict(alive=0, entries=0, npreds=0, vlen=0, mlen=0)
+    for ev in _chunked(events, chunk):
+        state, out = batch.scan(state, ev)
+        mx["alive"] = max(mx["alive"], int(jnp.max(jnp.sum(state.alive, -1))))
+        mx["entries"] = max(
+            mx["entries"], int(jnp.max(jnp.sum(state.slab.stage >= 0, -1)))
+        )
+        mx["npreds"] = max(mx["npreds"], int(jnp.max(state.slab.npreds)))
+        mx["vlen"] = max(
+            mx["vlen"],
+            int(jnp.max(state.vlen)),
+            int(jnp.max(state.slab.pvlen)),
+        )
+        mx["mlen"] = max(mx["mlen"], int(jnp.max(out.count)))
+        state = batch.sweep(state)
+    return ProbeReport(
+        counters=batch.counters(state),
+        max_alive_runs=mx["alive"],
+        max_live_entries=mx["entries"],
+        max_npreds=mx["npreds"],
+        max_vlen=mx["vlen"],
+        max_match_len=mx["mlen"],
+        config=config,
+    )
+
+
+def _round8(x: int) -> int:
+    return max(8, int(math.ceil(x / 8)) * 8)
+
+
+def suggest(tables, report: ProbeReport, margin: float = 1.5) -> EngineConfig:
+    """An ``EngineConfig`` from a probe report.
+
+    Structural floors come from the compiled tables: a run chain can hold
+    ``max_hops`` frames, every stage can hold a run, branching patterns
+    (``can_branch``) need sibling headroom; measured maxima get ``margin``
+    on top.  Shapes round to multiples of 8 (TPU sublane tile) except the
+    walk bound, which is exact work, not storage.
+    """
+    S = tables.num_stages
+    floor_runs = S + 2
+    branchy = 2 if tables.can_branch else 1
+    cfg = report.config
+    return dataclasses.replace(
+        cfg,
+        max_runs=_round8(
+            max(floor_runs, int(report.max_alive_runs * margin * branchy))
+        ),
+        slab_entries=_round8(
+            max(8, int(report.max_live_entries * margin))
+        ),
+        slab_preds=_round8(max(2, int(report.max_npreds * margin))),
+        dewey_depth=_round8(
+            max(tables.max_hops + 2, int(report.max_vlen * margin))
+        ),
+        max_walk=max(
+            tables.max_hops + 2, int(report.max_match_len * margin) + 2
+        ),
+    )
+
+
+def capacity_counters(counters: Dict[str, int]) -> Dict[str, int]:
+    """The capacity-relevant subset of an engine counters dict."""
+    return {k: counters[k] for k in _COUNTER_KNOB if k in counters}
+
+
+def autosize(
+    pattern,
+    events: EventBatch,
+    start: Optional[EngineConfig] = None,
+    margin: float = 1.5,
+    sweep_every: int = 16,
+    max_iters: int = 6,
+) -> EngineConfig:
+    """Probe -> grow what overflowed -> re-probe -> tighten -> verify.
+
+    Returns a config whose capacity counters are all zero on ``events``
+    (the sample); raises if ``max_iters`` doublings cannot get there.
+    The sample should be representative traffic — like sizing a JVM heap
+    from a load test, a heavier production trace can still overflow, and
+    the counters remain the runtime signal for that.
+    """
+    from kafkastreams_cep_tpu.compiler.tables import lower
+
+    cfg = start or EngineConfig(
+        max_runs=16, slab_entries=64, slab_preds=8, dewey_depth=16,
+        max_walk=16,
+    )
+    tables = lower(pattern)
+    report = None
+    for it in range(max_iters):
+        report = probe(pattern, events, cfg, sweep_every)
+        hot = {
+            k: v for k, v in capacity_counters(report.counters).items() if v
+        }
+        if not hot:
+            break
+        grown = {}
+        for counter in hot:
+            knob = _COUNTER_KNOB[counter]
+            grown[knob] = getattr(cfg, knob) * 2
+        logger.info("autosize iter %d: grew %s (counters %s)", it, grown, hot)
+        cfg = dataclasses.replace(cfg, **grown)
+    else:
+        raise RuntimeError(
+            f"autosize: counters still nonzero after {max_iters} iterations: "
+            f"{capacity_counters(report.counters)}"
+        )
+
+    tight = suggest(tables, report, margin)
+    verify = probe(pattern, events, tight, sweep_every)
+    if any(capacity_counters(verify.counters).values()):
+        # The margin under-covered an intra-chunk peak; keep the loose
+        # (verified-clean) config rather than iterate forever.
+        logger.info(
+            "autosize: tightened config overflowed (%s); keeping probe "
+            "config", capacity_counters(verify.counters),
+        )
+        return report.config
+    return tight

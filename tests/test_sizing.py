@@ -1,0 +1,105 @@
+"""Capacity estimation (``engine/sizing.py``) — configs derived, not
+hand-tuned.
+
+The reference never sizes anything (heap-backed stores,
+``CEPProcessor.java:144-149``); the array engine's static shapes are
+derived here from a probe of representative traffic.  Pinned:
+
+* ``probe`` reports counters + occupancy maxima;
+* ``autosize`` grows exactly the overflowing dimension and lands on a
+  config whose capacity counters are zero on the sample;
+* the derived config reproduces the oracle's matches (sizing must be a
+  pure capacity decision, never a semantics one).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kafkastreams_cep_tpu import OracleNFA, Query, TPUMatcher
+from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch, autosize, probe
+from kafkastreams_cep_tpu.engine.matcher import MatcherSession
+from kafkastreams_cep_tpu.engine.sizing import capacity_counters, suggest
+from kafkastreams_cep_tpu.compiler.tables import lower
+
+
+def kleene_pattern():
+    return (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] == 0)
+        .then()
+        .select("b").one_or_more().skip_till_any_match()
+        .where(lambda k, v, ts, st: (0 < v["x"]) & (v["x"] < 8))
+        .then()
+        .select("c").where(lambda k, v, ts, st: v["x"] >= 8)
+        .build()
+    )
+
+
+def sample_events(K=8, T=48, seed=3):
+    rng = np.random.default_rng(seed)
+    xs = np.concatenate(
+        [np.zeros((K, 1), np.int32),
+         rng.choice([0, 1, 2, 3, 9, 9], size=(K, T - 1)).astype(np.int32)],
+        axis=1,
+    )
+    return xs, EventBatch(
+        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
+        value={"x": jnp.asarray(xs)},
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+
+
+def test_probe_reports_occupancy_and_counters():
+    _, events = sample_events()
+    tiny = EngineConfig(
+        max_runs=4, slab_entries=8, slab_preds=2, dewey_depth=8, max_walk=6
+    )
+    rep = probe(kleene_pattern(), events, tiny, sweep_every=16)
+    assert rep.counters["run_drops"] > 0  # branching storm overflows 4 runs
+    assert rep.max_alive_runs >= 1
+    assert rep.max_live_entries >= 1
+    assert rep.max_vlen >= 2
+    assert rep.config is tiny
+
+
+def test_autosize_lands_loss_free_and_match_correct():
+    xs, events = sample_events()
+    tiny = EngineConfig(
+        max_runs=4, slab_entries=8, slab_preds=2, dewey_depth=8, max_walk=6
+    )
+    cfg = autosize(kleene_pattern(), events, start=tiny, sweep_every=16)
+    rep = probe(kleene_pattern(), events, cfg, sweep_every=16)
+    assert not any(capacity_counters(rep.counters).values()), rep.counters
+
+    # The derived config must agree with the oracle on a sample lane.
+    session = MatcherSession(TPUMatcher(kleene_pattern(), cfg))
+    oracle = OracleNFA.from_pattern(kleene_pattern())
+    for t, x in enumerate(xs[0]):
+        got = session.match(None, {"x": int(x)}, t, offset=t)
+        want = oracle.match(None, {"x": int(x)}, t, offset=t)
+        assert [m.as_map() for m in got] == [m.as_map() for m in want], t
+
+
+def test_suggest_applies_structural_floors():
+    pattern = kleene_pattern()
+    tables = lower(pattern)
+    _, events = sample_events(T=16)
+    generous = EngineConfig(
+        max_runs=64, slab_entries=128, slab_preds=16, dewey_depth=24,
+        max_walk=32,
+    )
+    rep = probe(pattern, events, generous, sweep_every=8)
+    cfg = suggest(tables, rep)
+    # Floors: never below the chain depth + slack, and shapes 8-aligned.
+    assert cfg.dewey_depth >= tables.max_hops + 2
+    assert cfg.max_walk >= tables.max_hops + 2
+    assert cfg.max_runs % 8 == 0 and cfg.slab_entries % 8 == 0
+    # Tighter than the generous probe config in at least one dimension.
+    assert (
+        cfg.max_runs < generous.max_runs
+        or cfg.slab_entries < generous.slab_entries
+        or cfg.dewey_depth < generous.dewey_depth
+    )
