@@ -222,18 +222,9 @@ def bench_lossfree(K, cycles, reps):
     if parity and os.environ.get("CEP_BENCH_LOSSFREE_PARITY", "1") != "0":
         lane = 0
         expected = _oracle_lane_matches(prices[lane], volumes[lane])
+        got_all = _decode_lane(out, names, lane)
         for t in range(T):
-            got = []
-            for r in range(count_np.shape[2]):
-                n = int(count_np[lane, t, r])
-                if n == 0:
-                    continue
-                m: dict = {}
-                for w in range(n):
-                    m.setdefault(
-                        names[int(stage_np[lane, t, r, w])], []
-                    ).append(int(off_np[lane, t, r, w]))
-                got.append(m)
+            got = got_all[t]
             if got != expected[t]:
                 parity = False
                 log(
@@ -261,6 +252,61 @@ def bench_lossfree(K, cycles, reps):
         f"(min of {reps}, spread {spread:.0f}%, compile {compile_s:.1f}s)"
     )
     return K * T / best, lossfree, parity
+
+
+def _decode_lane(out, names, lane):
+    """Engine emissions of one lane as per-event lists of name->offsets
+    dicts (the oracle's ``as_map`` structure; same decode the loss-free
+    parity check uses)."""
+    stage_np = np.asarray(out.stage[lane])  # [T, R, W]
+    off_np = np.asarray(out.off[lane])
+    count_np = np.asarray(out.count[lane])  # [T, R]
+    T, R = count_np.shape
+    per_event = []
+    for t in range(T):
+        got = []
+        for r in range(R):
+            n = int(count_np[t, r])
+            if n == 0:
+                continue
+            m: dict = {}
+            for w in range(n):
+                m.setdefault(names[int(stage_np[t, r, w])], []).append(
+                    int(off_np[t, r, w])
+                )
+            got.append(m)
+        per_event.append(got)
+    return per_event
+
+
+def _freeze(m):
+    return tuple(sorted((k, tuple(v)) for k, v in m.items()))
+
+
+def measure_recall(out, names, prices, volumes, lanes):
+    """Match recall/precision vs the host oracle on sampled lanes.
+
+    The reference never drops (``KVSharedVersionedBuffer.java:86-89``);
+    the headline config does (counted).  This quantifies the effect in
+    match space: recall = fraction of oracle matches the engine emitted,
+    precision = fraction of engine emissions the oracle agrees with —
+    per-event multiset intersection, so order inside an event is free but
+    nothing can be claimed across events."""
+    from collections import Counter
+
+    tot_o = tot_e = tot_hit = 0
+    for lane in lanes:
+        want = _oracle_lane_matches(prices[lane], volumes[lane])
+        got = _decode_lane(out, names, lane)
+        for t in range(len(want)):
+            co = Counter(_freeze(m) for m in want[t])
+            ce = Counter(_freeze(m) for m in got[t])
+            tot_o += sum(co.values())
+            tot_e += sum(ce.values())
+            tot_hit += sum((co & ce).values())
+    recall = tot_hit / tot_o if tot_o else 1.0
+    precision = tot_hit / tot_e if tot_e else 1.0
+    return recall, precision, tot_o
 
 
 def bench_engine(K, T, reps):
@@ -301,7 +347,29 @@ def bench_engine(K, T, reps):
         "the lossfree line below runs with all counters zero)")
     matches = int(jnp.sum(out.count > 0))
     log(f"engine: {matches} run-slots completed matches in final scan")
-    return K * T / best, spread
+    # The headline trace is adversarial for loss-free operation: probing it
+    # (engine/sizing.py) demands E=192/MP=32/D=48 — past the walk kernel's
+    # VMEM budget — because the converging avg fold keeps every lane
+    # match-dense for the whole scan (the reference holds the same state
+    # heap-side, 37K matches/1000 events on one lane).  So the headline
+    # number carries an explicit match recall against the oracle on
+    # sampled lanes instead of a counters_zero claim.
+    n_lanes = int(os.environ.get("CEP_BENCH_RECALL_LANES", "2"))
+    recall = precision = None
+    if n_lanes > 0:
+        prices = np.asarray(events.value["price"])
+        volumes = np.asarray(events.value["volume"])
+        lanes = list(range(0, K, max(K // n_lanes, 1)))[:n_lanes]
+        t0 = time.perf_counter()
+        recall, precision, n_oracle = measure_recall(
+            out, batch.names, prices, volumes, lanes
+        )
+        log(
+            f"engine: recall {recall:.4f} / precision {precision:.4f} vs "
+            f"oracle on {len(lanes)} sampled lanes ({n_oracle} oracle "
+            f"matches, {time.perf_counter() - t0:.1f}s)"
+        )
+    return K * T / best, spread, counters, recall, precision
 
 
 def bench_stencil(total_events, reps):
@@ -564,6 +632,54 @@ def bench_sharded_folds(K, T, reps):
     return K * T / best
 
 
+def bench_processor(K, T, n_batches):
+    """Processor-level throughput at the headline config (SURVEY §2.2 PP
+    row): columnar ingestion + pipelined dispatch + compacted decode.
+    The gap to the engine-level rate is the host runtime's overhead —
+    round 4 paid pack + full-grid pull + sync serially on every batch."""
+    from kafkastreams_cep_tpu.runtime import CEPProcessor
+
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    proc = CEPProcessor(
+        stock_demo.stock_pattern(), K, cfg, epoch=0, pipeline=True,
+        decode_budget=int(os.environ.get("CEP_BENCH_DECODE_BUDGET", "512")),
+    )
+    rng = np.random.default_rng(23)
+    N = K * T
+    keys = np.tile(np.arange(K, dtype=np.int64), T)
+    prices = rng.integers(90, 131, size=N).astype(np.int64)
+    volumes = rng.integers(600, 1101, size=N).astype(np.int64)
+
+    def feed(b):
+        ts = np.int64(b) * N + np.arange(N, dtype=np.int64)
+        return proc.process_columns(
+            keys, {"price": prices, "volume": volumes}, ts
+        )
+
+    t0 = time.perf_counter()
+    feed(0)
+    proc.flush()
+    log(f"processor: compile+first batch {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    n_matches = 0
+    for b in range(1, n_batches + 1):
+        n_matches += len(feed(b))
+    n_matches += len(proc.flush())
+    dt = time.perf_counter() - t0
+    snap = proc.metrics_snapshot()
+    log(
+        f"processor (pipelined columnar, {K} lanes x {T} ev x "
+        f"{n_batches} batches): {n_batches * N / dt / 1e3:.0f}K ev/s "
+        f"end-to-end, {n_matches} matches, decode_fallbacks "
+        f"{snap['decode_fallbacks']}, device {snap['device_seconds']:.2f}s "
+        f"decode {snap['decode_seconds']:.2f}s of {dt:.2f}s wall"
+    )
+    return n_batches * N / dt
+
+
 def bench_oracle(n_events):
     rng = np.random.default_rng(42)
     prices = rng.integers(90, 131, size=n_events)
@@ -606,7 +722,9 @@ def main():
 
     parity_gate()
     bench_stencil(int(os.environ.get("CEP_BENCH_STENCIL_N", "1048576")), reps)
-    engine_evps, engine_spread = bench_engine(K, T, reps)
+    engine_evps, engine_spread, engine_counters, recall, precision = (
+        bench_engine(K, T, reps)
+    )
     if os.environ.get("CEP_BENCH_LOSSFREE", "1") != "0":
         lf_evps, lf_zero, lf_parity = bench_lossfree(
             int(os.environ.get("CEP_BENCH_LOSSFREE_K", "1024")),
@@ -622,8 +740,16 @@ def main():
     # extra is skipped once the wall budget is spent — compiles through the
     # device tunnel are slow and the headline JSON must always be printed.
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
-        budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "900"))
+        budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "1200"))
         extras = [
+            (
+                "processor",
+                lambda: bench_processor(
+                    int(os.environ.get("CEP_BENCH_PROC_K", str(K))),
+                    int(os.environ.get("CEP_BENCH_PROC_T", "64")),
+                    int(os.environ.get("CEP_BENCH_PROC_BATCHES", "4")),
+                ),
+            ),
             (
                 "bank",
                 lambda: bench_bank(
@@ -637,10 +763,11 @@ def main():
                 "sharded-folds",
                 lambda: bench_sharded_folds(
                     # 262144 lanes fit the round-4 hand config; the derived
-                    # loss-free config is larger per lane (D=24+, E/MP from
-                    # the probe), so the default halves to keep slab HBM in
-                    # budget.  Throughput is per-event, not per-lane-count.
-                    int(os.environ.get("CEP_BENCH_SHARD_K", "131072")),
+                    # loss-free config is larger per lane (D=24, E/MP from
+                    # the probe — 131072 lanes RESOURCE_EXHAUSTED on v5e),
+                    # so the default quarters to keep slab HBM in budget.
+                    # Throughput is per-event, not per-lane-count.
+                    int(os.environ.get("CEP_BENCH_SHARD_K", "65536")),
                     int(os.environ.get("CEP_BENCH_SHARD_T", "16")),
                     max(reps - 1, 1),
                 ),
@@ -666,9 +793,13 @@ def main():
     print(
         json.dumps(
             {
+                # "capacity-bounded": the measured trace sheds state past
+                # the configured shapes (counted below + recall measured);
+                # the lossfree_* keys carry the zero-counters line.
                 "metric": (
                     "events/sec/chip, SASE stock pattern, "
-                    f"{K} key lanes x {T}-event scan, README match parity"
+                    f"{K} key lanes x {T}-event scan, capacity-bounded "
+                    "(see recall_sampled + counters)"
                 ),
                 "value": round(engine_evps, 1),
                 "unit": "events/s",
@@ -679,6 +810,15 @@ def main():
                 # publishes no numbers.
                 "vs_baseline": round(engine_evps / oracle_evps, 2),
                 "spread_pct": round(engine_spread, 1),
+                # Match-space effect of the counted drops, vs the oracle
+                # on sampled lanes (None when CEP_BENCH_RECALL_LANES=0).
+                "recall_sampled": (
+                    round(recall, 4) if recall is not None else None
+                ),
+                "precision_sampled": (
+                    round(precision, 4) if precision is not None else None
+                ),
+                "counters": engine_counters,
                 "lossfree_evps": round(lf_evps, 1),
                 "lossfree_counters_zero": bool(lf_zero),
                 "lossfree_oracle_parity": bool(lf_parity),

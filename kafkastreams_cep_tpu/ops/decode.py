@@ -1,0 +1,70 @@
+"""Device-side match compaction — shrink the decode transfer.
+
+The processor's decode pulls the scan's match outputs to the host.  Raw
+``StepOutput`` arrays are ``[K, T, R, W]`` — at the headline shape that is
+gigabytes per batch, nearly all of it zeros (match density is a fraction
+of a slot per lane-step), and the host pull dominates the processor's
+critical path (SURVEY §2.2 PP row; the reference's per-record loop never
+materializes a grid, ``CEPProcessor.java:154-163``).
+
+``compact_matches`` reduces the transfer on-device: per lane, the hit rows
+(``count > 0``) move to the front of a fixed ``budget`` of rows via a
+stable key sort (hits keep (t, r) scan order), plus the (t, r, count)
+metadata the host decode needs for arrival-order emission.  A one-shot
+batched gather is fine on TPU — the 4x-slower-gather finding in
+PROFILE_r04 applies to gathers inside while-loop bodies, not to a single
+post-scan op.  Lanes with more hits than ``budget`` are flagged; the
+processor falls back to the full pull for that batch (correctness never
+depends on the budget).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("budget",))
+def compact_matches(out, budget: int):
+    """``StepOutput [K, T, R, ...]`` -> compacted per-lane match rows.
+
+    Returns ``(stage [K, M, W], off [K, M, W], count [K, M], t [K, M],
+    r [K, M], overflow [] bool)`` with hit rows first in (t, r) scan
+    order; rows past a lane's hit count carry ``count == 0``.
+    """
+    K, T, R = out.count.shape
+    W = out.stage.shape[-1]
+    M = min(budget, T * R)
+    i32 = jnp.int32
+
+    count = out.count.reshape(K, T * R)
+    hit = count > 0
+    n_hits = jnp.sum(hit.astype(i32), axis=1)  # [K]
+    overflow = jnp.any(n_hits > M)
+
+    # Stable sort on the miss flag floats hits to the front in scan order.
+    order = jnp.argsort(
+        jnp.where(hit, 0, 1).astype(i32), axis=1, stable=True
+    )[:, :M]  # [K, M]
+
+    def rows(field):  # [K, T, R, W] -> [K, M, W]
+        return jnp.take_along_axis(
+            field.reshape(K, T * R, W), order[:, :, None], axis=1
+        )
+
+    def scalars(field):  # [K, N] -> [K, M]
+        return jnp.take_along_axis(field, order, axis=1)
+
+    n = jnp.arange(T * R, dtype=i32)
+    t_of = jnp.broadcast_to((n // R)[None, :], (K, T * R))
+    r_of = jnp.broadcast_to((n % R)[None, :], (K, T * R))
+    return (
+        rows(out.stage),
+        rows(out.off),
+        scalars(count),
+        scalars(t_of),
+        scalars(r_of),
+        overflow,
+    )

@@ -84,6 +84,15 @@ def save_checkpoint(
 
     ``extra`` rides along in the header for the caller's own bookkeeping
     (e.g. the supervisor's journal sequence number)."""
+    if getattr(processor, "_pending", None) is not None:
+        raise ValueError(
+            "pipelined processor holds an undecoded batch; call flush() "
+            "before checkpointing (a snapshot cannot carry device outputs)"
+        )
+    if processor._col_batches:
+        # Lazy columnar batches (process_columns) materialize their live
+        # rows into the picklable mirror; dead rows are dropped.
+        processor._gc_events()
     arrays = _flatten_state(processor.state)
     header = {
         "format_version": FORMAT_VERSION,
@@ -103,6 +112,8 @@ def save_checkpoint(
         "dedup": processor.dedup,
         "gc_interval": processor.gc_interval,
         "gc_events_interval": processor.gc_events_interval,
+        "decode_budget": processor.decode_budget,
+        "pipeline": processor.pipeline,
         "lane_of": dict(processor._lane_of),
         "next_offset": processor._next_offset.copy(),
         "off_base": processor._off_base.copy(),
@@ -164,6 +175,8 @@ def restore_processor(
         dedup=header.get("dedup", True),
         gc_interval=header.get("gc_interval", 0),
         gc_events_interval=header.get("gc_events_interval", 8),
+        decode_budget=header.get("decode_budget", 128),
+        pipeline=header.get("pipeline", False),
         mesh=mesh,
     )
     if list(proc.batch.names) != list(header["stage_names"]):

@@ -113,6 +113,8 @@ class CEPProcessor:
         dedup: bool = True,
         gc_interval: int = 16,
         gc_events_interval: int = 8,
+        decode_budget: int = 128,
+        pipeline: bool = False,
         mesh=None,
     ):
         # ``mesh``: a ``jax.sharding.Mesh`` shards the lane axis over the
@@ -143,6 +145,19 @@ class CEPProcessor:
         # keys + run state; amortizing it every N batches keeps the host
         # mirror bounded without a per-batch sync (VERDICT round-4 item 9).
         self.gc_events_interval = max(int(gc_events_interval), 1)
+        # Per-lane rows of the compacted decode pull (0 = always pull the
+        # raw [K, T, R, W] grid); see _decode.
+        self.decode_budget = int(decode_budget)
+        # Pipelined mode (SURVEY §2.2 PP row — the fetch-ahead overlap the
+        # reference gets from Kafka Streams' poll loop): process() returns
+        # the PREVIOUS batch's matches, so batch N's device scan overlaps
+        # batch N+1's host packing and batch N-1's decode.  Call flush()
+        # to drain the last batch.  Match content is identical to the
+        # serial mode, one call later; the host-event GC cadence drains
+        # the pipeline first (its liveness pull must not prune events a
+        # pending decode still references).
+        self.pipeline = bool(pipeline)
+        self._pending: Optional[tuple] = None
         self.state = self.batch.init_state()
         self.epoch = epoch  # None = rebase to the first record's timestamp
         self.gc_events = gc_events
@@ -157,6 +172,11 @@ class CEPProcessor:
         self._off_base = np.full(self.num_lanes, -1, dtype=np.int64)
         # Host event mirror, keyed by *device* (rebased) offset per lane.
         self._events: List[Dict[int, Event]] = [dict() for _ in range(self.num_lanes)]
+        # Columnar-path batches (process_columns): events stay as packed
+        # [K, T] columns until a decode or GC touches them — match-sparse
+        # streams then never pay per-record Event construction.  Each entry
+        # is (start [K], count [K], abs_ts [K, T], value leaves [K, T]...).
+        self._col_batches: List[tuple] = []
         self._value_proto = None
         self.metrics = Metrics()
 
@@ -361,6 +381,167 @@ class CEPProcessor:
             off=jnp.asarray(off),
             valid=jnp.asarray(valid),
         )
+        return self._dispatch(events, rank_of, len(records) - dropped)
+
+    def process_columns(
+        self, keys, values, timestamps
+    ) -> List[Tuple[Hashable, Sequence]]:
+        """Columnar ingestion: ``[N]`` arrays instead of Record objects.
+
+        The per-record :meth:`process` spends microseconds of Python per
+        record (validation, Event construction) — fine at Kafka-consumer
+        rates, the wall at engine rates.  This path validates and packs
+        with array ops and defers Event construction until a match (or the
+        GC) actually touches an event, so match-sparse streams never pay
+        it (the packed columns themselves are the mirror).
+
+        ``keys`` is an ``[N]`` array (numeric keys vectorize; object keys
+        fall back to a Python mapping pass), ``values`` a pytree of ``[N]``
+        arrays with the schema's structure, ``timestamps`` ``[N]`` ints.
+        Offsets are always auto-assigned — explicit-offset replay/dedup
+        needs the per-record path.  Emitted Events carry values rebuilt
+        from the packed columns (schema dtypes), not the caller's original
+        scalars."""
+        keys_arr = np.asarray(keys)
+        ts_arr = np.asarray(timestamps, dtype=np.int64)
+        n = int(keys_arr.shape[0])
+        if n == 0:
+            return []
+        K = self.num_lanes
+        if self.epoch is None:
+            self.epoch = int(ts_arr[0])
+        leaves_in, treedef_in = jax.tree_util.tree_flatten(values)
+        leaves_in = [np.asarray(l) for l in leaves_in]
+        if self._value_proto is None:
+            self._value_proto = jax.tree_util.tree_unflatten(
+                treedef_in,
+                [
+                    np.dtype(np.float32)
+                    if np.issubdtype(l.dtype, np.floating)
+                    else np.dtype(np.int32)
+                    for l in leaves_in
+                ],
+            )
+        dtypes, treedef = jax.tree_util.tree_flatten(self._value_proto)
+        if treedef_in != treedef:
+            raise ValueError(
+                "value columns structure differs from the schema fixed by "
+                "the first batch"
+            )
+        for l, dt in zip(leaves_in, dtypes):
+            if l.shape != (n,):
+                raise ValueError(
+                    f"value column shape {l.shape} != ({n},)"
+                )
+            if np.issubdtype(l.dtype, np.floating) and not np.issubdtype(
+                dt, np.floating
+            ):
+                raise ValueError(
+                    "float column in a field the schema typed as int"
+                )
+
+        # Lane mapping, committed atomically after the overflow check.
+        if keys_arr.dtype == object:
+            uniq = list(dict.fromkeys(keys_arr.tolist()))
+        else:
+            vals, first = np.unique(keys_arr, return_index=True)
+            uniq = [v.item() for v in vals[np.argsort(first)]]
+        new = [k for k in uniq if k not in self._lane_of]
+        if len(self._lane_of) + len(new) > K:
+            raise ValueError(
+                f"more than num_lanes={K} distinct keys; size the "
+                "processor for the key cardinality it serves"
+            )
+        for k in new:
+            lane = len(self._lane_of)
+            self._lane_of[k] = lane
+            self._key_of[lane] = k
+            logger.info("assigned key %r to lane %d", k, lane)
+        if keys_arr.dtype == object:
+            lanes_arr = np.fromiter(
+                (self._lane_of[k] for k in keys_arr.tolist()),
+                dtype=np.int32, count=n,
+            )
+        else:
+            ku = np.fromiter(self._lane_of.keys(), dtype=keys_arr.dtype)
+            lv = np.fromiter(self._lane_of.values(), dtype=np.int32)
+            order = np.argsort(ku)
+            lanes_arr = lv[order][
+                np.searchsorted(ku[order], keys_arr)
+            ].astype(np.int32)
+
+        rel = ts_arr - self.epoch
+        if rel.size and (rel.min() < _I32.min or rel.max() > _I32.max):
+            raise ValueError(
+                "timestamps outside int32 device time relative to the "
+                f"processor epoch {self.epoch}"
+            )
+
+        keep = np.ones(n, dtype=np.uint8)
+        pos, qlen, max_len = native.queue_positions(lanes_arr, keep, K)
+        # Auto offsets: lane l's batch rows take consecutive log positions
+        # from its high-water mark; a fresh lane's base pins to it.
+        fresh = (self._off_base < 0) & (qlen > 0)
+        self._off_base[fresh] = self._next_offset[fresh]
+        start_dev = self._next_offset - self._off_base  # [K] first dev off
+        dev_off = (start_dev[lanes_arr] + pos).astype(np.int64)
+        if dev_off.size and dev_off.max() >= OFFSET_LIMIT:
+            raise ValueError(
+                "per-lane log positions past 2^24 (engine f32 pointer "
+                "packing) — rotate the processor via checkpoint/restore"
+            )
+        self._next_offset += qlen
+
+        T = _bucket(max_len)
+        # Per-key decision, exactly like _key_code on the record path: an
+        # int32-range integer key passes through, anything else is its
+        # lane index (an out-of-range batch-mate must not change another
+        # key's code).
+        if np.issubdtype(keys_arr.dtype, np.integer):
+            in_range = (keys_arr >= _I32.min) & (keys_arr <= _I32.max)
+            key_codes = np.where(
+                in_range, keys_arr.astype(np.int64),
+                lanes_arr.astype(np.int64),
+            ).astype(np.int32)
+        else:
+            key_codes = lanes_arr.astype(np.int32)
+        key_arr = np.zeros((K, T), dtype=np.int32)
+        ts = np.zeros((K, T), dtype=np.int32)
+        off = np.zeros((K, T), dtype=np.int32)
+        valid = np.zeros((K, T), dtype=bool)
+        rank_of = np.full((K, T), -1, dtype=np.int64)
+        abs_ts = np.zeros((K, T), dtype=np.int64)
+        native.pack_column(key_arr, key_codes, lanes_arr, pos, keep)
+        native.pack_column(ts, rel.astype(np.int32), lanes_arr, pos, keep)
+        native.pack_column(off, dev_off.astype(np.int32), lanes_arr, pos, keep)
+        native.pack_column(rank_of, np.arange(n, dtype=np.int64), lanes_arr, pos, keep)
+        native.pack_column(abs_ts, ts_arr, lanes_arr, pos, keep)
+        native.pack_valid(valid, lanes_arr, pos, keep)
+        val_leaves = [np.zeros((K, T), dtype=dt) for dt in dtypes]
+        for i, dt in enumerate(dtypes):
+            native.pack_column(
+                val_leaves[i], leaves_in[i].astype(dt), lanes_arr, pos, keep
+            )
+
+        # Lazy mirror: the packed columns ARE the event store until a
+        # match or the GC touches a row.
+        col_start = np.where(qlen > 0, start_dev, -1).astype(np.int64)
+        self._col_batches.append(
+            (col_start, qlen.astype(np.int64), abs_ts, val_leaves)
+        )
+
+        events = EventBatch(
+            key=jnp.asarray(key_arr),
+            value=jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(v) for v in val_leaves]
+            ),
+            ts=jnp.asarray(ts),
+            off=jnp.asarray(off),
+            valid=jnp.asarray(valid),
+        )
+        return self._dispatch(events, rank_of, n)
+
+    def _dispatch(self, events, rank_of, n_records):
         if self.mesh is not None:
             events = self.batch.shard_events(events)
 
@@ -368,47 +549,133 @@ class CEPProcessor:
             self.state, out = self.batch.scan(self.state, events)
             if self.gc_interval and (self.metrics.batches + 1) % self.gc_interval == 0:
                 self.state = self.batch.sweep(self.state)
-            jax.block_until_ready(out.count)
+            if not self.pipeline:
+                # Serial mode: wait here so device_seconds is the real
+                # device wall time.  Pipelined mode never blocks on the
+                # fresh dispatch — the wait lands in the next call's
+                # decode of THIS batch, overlapped with its device scan.
+                jax.block_until_ready(out.count)
+        gc_due = self.gc_events and (
+            (self.metrics.batches + 1) % self.gc_events_interval == 0
+        )
+        self.metrics.records_in += n_records
+        self.metrics.batches += 1
+        with self.metrics.timed("decode_seconds"):
+            if self.pipeline:
+                prev, self._pending = self._pending, (out, rank_of)
+                matches = self._decode(*prev) if prev is not None else []
+                if gc_due:
+                    # The GC liveness pull must not prune events the
+                    # still-pending decode references: drain first.
+                    pend, self._pending = self._pending, None
+                    matches += self._decode(*pend)
+                    self._gc_events()
+            else:
+                matches = self._decode(out, rank_of)
+                if gc_due:
+                    self._gc_events()
+        self.metrics.matches_out += len(matches)
+        return matches
+
+    def flush(self) -> List[Tuple[Hashable, Sequence]]:
+        """Drain the pipelined in-flight batch (no-op in serial mode or
+        when nothing is pending).  Call before checkpointing a pipelined
+        processor — a snapshot cannot carry undecoded device outputs."""
+        if self._pending is None:
+            return []
+        out, rank_of = self._pending
+        self._pending = None
         with self.metrics.timed("decode_seconds"):
             matches = self._decode(out, rank_of)
-            if self.gc_events and (
-                (self.metrics.batches + 1) % self.gc_events_interval == 0
-            ):
-                self._gc_events()
-        self.metrics.records_in += len(records) - dropped
         self.metrics.matches_out += len(matches)
-        self.metrics.batches += 1
         return matches
 
     def _decode(self, out, rank_of) -> List[Tuple[Hashable, Sequence]]:
         """Device walk outputs -> (key, Sequence), in arrival order.
 
-        Vectorized: one device_get, hit discovery and ordering in numpy;
-        Python touches only actual match rows (typically a tiny fraction of
-        [K, T, R]), not the full grid.
+        Fast path: the match rows compact on-device into
+        ``decode_budget`` rows per lane (``ops/decode.py``), so the host
+        pulls megabytes instead of the raw ``[K, T, R, W]`` grid —
+        gigabytes at production shapes, and the processor's former
+        critical-path wall (SURVEY §2.2 PP row).  A lane with more hits
+        than the budget falls back to the full pull for that batch
+        (counted in ``decode_fallbacks``; correctness never depends on
+        the budget).
         """
+        if self.decode_budget:
+            from kafkastreams_cep_tpu.ops.decode import compact_matches
+
+            c_stage, c_off, c_count, c_t, c_r, overflow = compact_matches(
+                out, self.decode_budget
+            )
+            if not bool(overflow):
+                # One transfer for all five arrays — per-pull latency is
+                # exactly what this path exists to avoid.
+                count, stage, off, t_arr, r_arr = jax.device_get(
+                    (c_count, c_stage, c_off, c_t, c_r)
+                )
+                ks, ms = np.nonzero(count)
+                if ks.size == 0:
+                    return []
+                return self._emit(
+                    ks, t_arr[ks, ms], r_arr[ks, ms], count[ks, ms],
+                    stage[ks, ms], off[ks, ms], rank_of,
+                )
+            self.metrics.decode_fallbacks += 1
         stage = np.asarray(jax.device_get(out.stage))  # [K, T, R, W]
         off = np.asarray(jax.device_get(out.off))
         count = np.asarray(jax.device_get(out.count))  # [K, T, R]
-        names = self.batch.names
         ks, ts, rs = np.nonzero(count)
         if ks.size == 0:
             return []
-        # Arrival order (rank of the completing record), then queue order.
+        return self._emit(
+            ks, ts, rs, count[ks, ts, rs], stage[ks, ts, rs],
+            off[ks, ts, rs], rank_of,
+        )
+
+    def _emit(self, ks, ts, rs, cnts, stages, offs, rank_of):
+        """Hit rows -> (key, Sequence) in arrival order (rank of the
+        completing record), then run-queue order."""
         order = np.lexsort((rs, rank_of[ks, ts]))
-        ks, ts, rs = ks[order], ts[order], rs[order]
-        cnts = count[ks, ts, rs]
-        stages = stage[ks, ts, rs]  # [M, W]
-        offs = off[ks, ts, rs]
+        ks, cnts = ks[order], cnts[order]
+        stages, offs = stages[order], offs[order]
+        names = self.batch.names
         matches: List[Tuple[Hashable, Sequence]] = []
         for i in range(ks.size):
             k = int(ks[i])
             seq = Sequence()
-            ev_store = self._events[k]
             for w in range(int(cnts[i])):
-                seq.add(names[int(stages[i, w])], ev_store[int(offs[i, w])])
+                seq.add(
+                    names[int(stages[i, w])],
+                    self._event_at(k, int(offs[i, w])),
+                )
             matches.append((self._key_of[k], seq))
         return matches
+
+    def _event_at(self, lane: int, off: int) -> Event:
+        """Event by (lane, device offset): the materialized mirror first,
+        then the lazy column batches (newest first), caching on hit."""
+        ev = self._events[lane].get(off)
+        if ev is not None:
+            return ev
+        for start, cnt, abs_ts, leaves in reversed(self._col_batches):
+            s = int(start[lane])
+            if s >= 0 and s <= off < s + int(cnt[lane]):
+                ev = self._materialize(lane, off, s, abs_ts, leaves)
+                self._events[lane][off] = ev
+                return ev
+        raise KeyError(f"lane {lane} has no event at device offset {off}")
+
+    def _materialize(self, lane, off, start, abs_ts, leaves) -> Event:
+        t = off - start
+        dtypes, treedef = jax.tree_util.tree_flatten(self._value_proto)
+        value = jax.tree_util.tree_unflatten(
+            treedef, [l[lane, t].item() for l in leaves]
+        )
+        return Event(
+            self._key_of[lane], value, int(abs_ts[lane, t]), self.topic,
+            lane, off + int(self._off_base[lane]),
+        )
 
     def _gc_events(self) -> None:
         """Drop host events no longer reachable from device state.
@@ -425,10 +692,23 @@ class CEPProcessor:
         for k in range(self.num_lanes):
             live = set(slab_off[k][slab_stage[k] >= 0].tolist())
             live.update(run_off[k][run_alive[k]].tolist())
+            # Live rows still sitting in lazy column batches materialize
+            # now (the batches are dropped below); dead rows never do.
+            for start, cnt, abs_ts, leaves in self._col_batches:
+                s = int(start[k])
+                if s < 0:
+                    continue
+                hi = s + int(cnt[k])
+                for o in live:
+                    if s <= o < hi and o not in self._events[k]:
+                        self._events[k][o] = self._materialize(
+                            k, o, s, abs_ts, leaves
+                        )
             store = self._events[k]
             dead = [o for o in store if o not in live]
             for o in dead:
                 del store[o]
+        self._col_batches.clear()
 
     def place(self, state):
         """Device placement for host-built state (mesh-aware) — used by

@@ -24,6 +24,7 @@ class Metrics:
     matches_out: int = 0
     batches: int = 0
     duplicates_dropped: int = 0
+    decode_fallbacks: int = 0  # compacted decode overflowed its budget
     device_seconds: float = 0.0
     decode_seconds: float = 0.0
 
@@ -35,6 +36,7 @@ class Metrics:
             "matches_out": self.matches_out,
             "batches": self.batches,
             "duplicates_dropped": self.duplicates_dropped,
+            "decode_fallbacks": self.decode_fallbacks,
             "device_seconds": round(self.device_seconds, 6),
             "decode_seconds": round(self.decode_seconds, 6),
         }

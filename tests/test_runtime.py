@@ -280,3 +280,154 @@ def test_checkpoint_refuses_fold_dtype_flip(tmp_path):
     with pytest.raises(ValueError, match="dtypes"):
         restore_processor(fold_pattern(0.0), path)
     restore_processor(fold_pattern(0), path)  # same dtype restores fine
+
+
+def _run_batches(proc, batches):
+    out = [proc.process(b) for b in batches]
+    return out
+
+
+def _fmt_all(match_lists):
+    return [
+        [(k, [(n, tuple(e.offset for e in evs))
+              for n, evs in seq.as_map().items()]) for k, seq in ms]
+        for ms in match_lists
+    ]
+
+
+def _random_records(n, keys, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        Record(int(rng.integers(0, keys)),
+               {"price": int(rng.integers(90, 131)),
+                "volume": int(rng.integers(600, 1101))},
+               1000 + i)
+        for i in range(n)
+    ]
+
+
+def test_compacted_decode_matches_full_pull():
+    """decode_budget on vs off must emit identical matches; a budget of 1
+    overflows on match-dense batches and falls back (counted), still
+    identical."""
+    recs = _random_records(180, keys=8, seed=21)
+    batches = [recs[i:i + 36] for i in range(0, len(recs), 36)]
+    full = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg(),
+                        decode_budget=0)
+    fast = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg(),
+                        decode_budget=128)
+    tiny = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg(),
+                        decode_budget=1)
+    want = _fmt_all(_run_batches(full, batches))
+    assert _fmt_all(_run_batches(fast, batches)) == want
+    assert _fmt_all(_run_batches(tiny, batches)) == want
+    assert fast.metrics.decode_fallbacks == 0
+    assert tiny.metrics.decode_fallbacks > 0
+
+
+def test_pipelined_processor_emits_identical_one_call_late():
+    """pipeline=True returns batch N-1's matches from call N; with a
+    final flush() the concatenated match stream is byte-identical to the
+    serial processor's, including across the host-event GC drain."""
+    recs = _random_records(240, keys=8, seed=22)
+    batches = [recs[i:i + 30] for i in range(0, len(recs), 30)]
+    serial = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg())
+    piped = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg(),
+                         pipeline=True, gc_events_interval=3)
+    want = _fmt_all(_run_batches(serial, batches))
+    got = _fmt_all(_run_batches(piped, batches) + [piped.flush()])
+    flat_want = [m for ms in want for m in ms]
+    flat_got = [m for ms in got for m in ms]
+    assert flat_got == flat_want
+    # The shift really happened: call 0 returned nothing.
+    assert got[0] == []
+
+
+def test_process_columns_matches_per_record_path(tmp_path):
+    """Columnar ingestion must emit exactly the per-record path's matches
+    (auto-offset mode), lazily materializing only touched events, and
+    survive a checkpoint round-trip (columns drain into the mirror)."""
+    from kafkastreams_cep_tpu.runtime import restore_processor, save_checkpoint
+
+    rng = np.random.default_rng(31)
+    N, KEYS = 240, 8
+    keys = rng.integers(0, KEYS, size=N).astype(np.int64)
+    prices = rng.integers(90, 131, size=N).astype(np.int64)
+    volumes = rng.integers(600, 1101, size=N).astype(np.int64)
+    ts = 1000 + np.arange(N, dtype=np.int64)
+
+    ref = CEPProcessor(stock_demo.stock_pattern(), KEYS, stock_cfg())
+    want = []
+    for i in range(0, N, 48):
+        want.append(ref.process([
+            Record(int(keys[j]), {"price": int(prices[j]),
+                                  "volume": int(volumes[j])}, int(ts[j]))
+            for j in range(i, min(i + 48, N))
+        ]))
+
+    col = CEPProcessor(stock_demo.stock_pattern(), KEYS, stock_cfg())
+    got = []
+    for i in range(0, N, 48):
+        sl = slice(i, min(i + 48, N))
+        got.append(col.process_columns(
+            keys[sl], {"price": prices[sl], "volume": volumes[sl]}, ts[sl]
+        ))
+    assert _fmt_all(got) == _fmt_all(want)
+    # Event payloads match too (values rebuilt from columns).
+    for (gk, gseq), (wk, wseq) in zip(got[-1], want[-1]):
+        assert gk == wk
+        for (gn, gevs), (wn, wevs) in zip(
+            gseq.as_map().items(), wseq.as_map().items()
+        ):
+            assert gn == wn
+            for ge, we in zip(gevs, wevs):
+                assert ge.value == we.value
+                assert ge.timestamp == we.timestamp
+                assert ge.offset == we.offset
+
+    # Checkpoint drains the lazy columns; restore + more columns works.
+    path = str(tmp_path / "col.ckpt")
+    save_checkpoint(col, path)
+    ref2 = restore_processor(stock_demo.stock_pattern(), path)
+    more_w = ref2.process([
+        Record(1, {"price": 100, "volume": 1200}, 5000),
+        Record(1, {"price": 120, "volume": 800}, 5001),
+    ])
+    col2 = restore_processor(stock_demo.stock_pattern(), path)
+    more_g = col2.process_columns(
+        np.asarray([1, 1]),
+        {"price": np.asarray([100, 120]), "volume": np.asarray([1200, 800])},
+        np.asarray([5000, 5001]),
+    )
+    assert _fmt_all([more_g]) == _fmt_all([more_w])
+
+
+def test_process_columns_pipelined_and_gc():
+    """Columnar + pipeline + host-event GC cadence together: same match
+    stream as the serial per-record processor."""
+    rng = np.random.default_rng(33)
+    N, KEYS = 360, 8
+    keys = rng.integers(0, KEYS, size=N).astype(np.int64)
+    prices = rng.integers(90, 131, size=N).astype(np.int64)
+    volumes = rng.integers(600, 1101, size=N).astype(np.int64)
+    ts = 1000 + np.arange(N, dtype=np.int64)
+
+    ref = CEPProcessor(stock_demo.stock_pattern(), KEYS, stock_cfg())
+    want = []
+    for i in range(0, N, 40):
+        want += ref.process([
+            Record(int(keys[j]), {"price": int(prices[j]),
+                                  "volume": int(volumes[j])}, int(ts[j]))
+            for j in range(i, min(i + 40, N))
+        ])
+
+    col = CEPProcessor(stock_demo.stock_pattern(), KEYS, stock_cfg(),
+                       pipeline=True, gc_events_interval=3)
+    got = []
+    for i in range(0, N, 40):
+        sl = slice(i, min(i + 40, N))
+        got += col.process_columns(
+            keys[sl], {"price": prices[sl], "volume": volumes[sl]}, ts[sl]
+        )
+    got += col.flush()
+    assert _fmt_all([got]) == _fmt_all([want])
