@@ -495,9 +495,19 @@ def bench_kleene(K, T, reps):
     return rate
 
 
-def bench_bank(n_queries, K, T, reps):
+def bench_bank(n_list, total_lanes, T, reps):
     """BASELINE.json config 3: multi-pattern NFA bank over ~100K total key
-    lanes — N independent queries, each vmapped over K lanes (stderr)."""
+    lanes — N parameterized query variants over the same stream, serial
+    (one dispatch per query, the reference's one-CEPProcessor-per-pattern
+    composition) vs stacked (one dispatch for the whole bank,
+    parallel/stacked.py), at each bank width in ``n_list``.  The
+    auto-chooser (choose_bank) picks per width from a 128-lane sample;
+    its pick is logged next to the full-size outcome."""
+    from kafkastreams_cep_tpu.parallel.stacked import (
+        StackedBankMatcher,
+        choose_bank,
+    )
+
     def q(i):
         lo, hi = 95 + i * 5, 120 - i * 3
         return (
@@ -513,59 +523,72 @@ def bench_bank(n_queries, K, T, reps):
         max_runs=8, slab_entries=16, slab_preds=4, dewey_depth=6, max_walk=6
     )
     rng = np.random.default_rng(13)
-    prices = rng.integers(80, 141, size=(K, T)).astype(np.int32)
-    events = EventBatch(
-        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
-        value={"price": jnp.asarray(prices)},
-        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
-        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
-        valid=jnp.ones((K, T), bool),
-    )
-    matchers = [BatchMatcher(q(i), K, cfg) for i in range(n_queries)]
-    states = [m.init_state() for m in matchers]
-    outs = [m.scan(s, events) for m, s in zip(matchers, states)]
-    jax.block_until_ready([o[1].count for o in outs])
-    best = float("inf")
-    for _ in range(reps):
+    results = {}
+    for N in n_list:
+        K = max((total_lanes // N) // 128 * 128, 128)
+        prices = rng.integers(80, 141, size=(K, T)).astype(np.int32)
+        events = EventBatch(
+            key=jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
+            value={"price": jnp.asarray(prices)},
+            ts=jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+            off=jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+            valid=jnp.ones((K, T), bool),
+        )
+        patterns = [q(i) for i in range(N)]
+        sample = jax.tree_util.tree_map(lambda x: x[:128], events)
+        mode, det = choose_bank(patterns, 128, cfg, sample, reps=1)
+
         t0 = time.perf_counter()
+        matchers = [BatchMatcher(p, K, cfg) for p in patterns]
+        states = [m.init_state() for m in matchers]
         outs = [m.scan(s, events) for m, s in zip(matchers, states)]
         jax.block_until_ready([o[1].count for o in outs])
-        best = min(best, time.perf_counter() - t0)
-    total = n_queries * K * T
-    log(
-        f"bank/serial ({n_queries} queries x {K} lanes = {n_queries * K} "
-        f"query-lanes, {T} events): {total / best / 1e3:.0f}K query-events/s"
-    )
-    serial = total / best
+        serial_compile = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            outs = [m.scan(s, events) for m, s in zip(matchers, states)]
+            jax.block_until_ready([o[1].count for o in outs])
+            best = min(best, time.perf_counter() - t0)
+        total = N * K * T
+        serial = total / best
+        del matchers, states, outs  # free HBM before the fused compile
 
-    # Fused: the same queries stacked on a leading query axis in ONE
-    # compiled dispatch (parallel/stacked.py; BASELINE config 4 proper).
-    from kafkastreams_cep_tpu.parallel.stacked import StackedBankMatcher
-
-    del matchers, states, outs  # free HBM before the fused compile
-    bank = StackedBankMatcher([q(i) for i in range(n_queries)], K, cfg)
-    bstate0 = bank.init_state()
-    bstate, bout = bank.scan(bstate0, events)
-    jax.block_until_ready(bout.count)
-    bbest = float("inf")
-    for _ in range(reps):
         t0 = time.perf_counter()
+        bank = StackedBankMatcher(patterns, K, cfg)
+        bstate0 = bank.init_state()
         bstate, bout = bank.scan(bstate0, events)
         jax.block_until_ready(bout.count)
-        bbest = min(bbest, time.perf_counter() - t0)
-    log(
-        f"bank/fused  (one dispatch, {n_queries * K} stacked query-lanes): "
-        f"{total / bbest / 1e3:.0f}K query-events/s "
-        f"({best / bbest:.2f}x serial; fused pays every query's predicates "
-        "per lane, so small banks of cheap queries can favor serial)"
-    )
-    # Both variants reported — a consumer must not mistake a serial win
-    # for a fused number (or vice versa).
-    return {
-        "serial_qevps": serial,
-        "fused_qevps": total / bbest,
-        "winner": "fused" if bbest < best else "serial",
-    }
+        fused_compile = time.perf_counter() - t0
+        bbest = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            bstate, bout = bank.scan(bstate0, events)
+            jax.block_until_ready(bout.count)
+            bbest = min(bbest, time.perf_counter() - t0)
+        fused = total / bbest
+        del bank, bstate0, bstate, bout
+
+        winner = "fused" if bbest < best else "serial"
+        agreed = (mode == "stacked") == (winner == "fused")
+        log(
+            f"bank[N={N}] ({N} queries x {K} lanes, {T} events): "
+            f"serial {serial / 1e3:.0f}K q-ev/s (compile {serial_compile:.0f}s"
+            f" for {N} programs), fused {fused / 1e3:.0f}K q-ev/s (compile "
+            f"{fused_compile:.0f}s for 1), fused/serial {best / bbest:.2f}x; "
+            f"chooser picked {mode} on the 128-lane sample "
+            f"({'agrees' if agreed else 'DISAGREES'} with full size)"
+        )
+        results[N] = {
+            "serial_qevps": serial,
+            "fused_qevps": fused,
+            "winner": winner,
+            "chooser": mode,
+        }
+    return results
 
 
 def bench_sharded_folds(K, T, reps):
@@ -753,8 +776,12 @@ def main():
             (
                 "bank",
                 lambda: bench_bank(
-                    int(os.environ.get("CEP_BENCH_BANK_N", "2")),
-                    int(os.environ.get("CEP_BENCH_BANK_K", "51200")),
+                    [
+                        int(x) for x in os.environ.get(
+                            "CEP_BENCH_BANK_N", "2,8,16"
+                        ).split(",")
+                    ],
+                    int(os.environ.get("CEP_BENCH_BANK_K", "102400")),
                     int(os.environ.get("CEP_BENCH_BANK_T", "64")),
                     max(reps - 1, 1),
                 ),

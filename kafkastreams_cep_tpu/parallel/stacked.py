@@ -141,3 +141,80 @@ class StackedBankMatcher:
             n: int(jnp.sum(v))
             for n, v in zip(COUNTER_NAMES, counter_values(state))
         }
+
+
+def choose_bank(
+    patterns: Sequence,
+    lanes_per_query: int,
+    config: Optional[EngineConfig] = None,
+    sample_events: Optional[EventBatch] = None,
+    reps: int = 2,
+) -> Tuple[str, Dict[str, float]]:
+    """Serial vs stacked, decided the way capacity is (engine/sizing.py):
+    by measurement, not a cost model.
+
+    The tradeoff is real in both directions: stacking runs the bank in one
+    dispatch (one compile, one launch, better utilization at small
+    per-query widths) but the stacked step evaluates *every* query's
+    predicates on every lane (``engine/matcher.py eval_preds``), so wide
+    lane counts with pred-heavy queries can favor the serial loop.  Where
+    the crossover falls depends on Q, K, T, the pattern, and the backend —
+    so when ``sample_events`` (a ``[K_s, T]`` batch, small ``K_s``) is
+    given, both variants are timed on it and the faster wins.  Without a
+    sample: non-stackable banks are serial by necessity, stackable ones
+    default to stacked (the single-compile saving alone is decisive for
+    short streams — a serial bank compiles once per query).
+
+    Returns ``(mode, details)`` with measured rates in ``details`` when a
+    sample was timed."""
+    import time
+
+    from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+
+    tlist = [
+        p if isinstance(p, TransitionTables) else lower(p) for p in patterns
+    ]
+    if not stackable(tlist):
+        return "serial", {"reason": "not stackable"}
+    if sample_events is None:
+        return "stacked", {"reason": "no sample; one compile beats Q"}
+
+    K_s = int(sample_events.ts.shape[0])
+
+    def best_of(fn):
+        fn()  # compile + warm
+        t = float("inf")
+        for _ in range(max(reps, 1)):
+            t0 = time.perf_counter()
+            fn()
+            t = min(t, time.perf_counter() - t0)
+        return t
+
+    serial_ms = [BatchMatcher(t, K_s, config) for t in tlist]
+    serial_states = [m.init_state() for m in serial_ms]
+
+    def run_serial():
+        outs = [
+            m.scan(s, sample_events)
+            for m, s in zip(serial_ms, serial_states)
+        ]
+        jax.block_until_ready([o[1].count for o in outs])
+
+    t_serial = best_of(run_serial)
+
+    stacked = StackedBankMatcher(tlist, K_s, config)
+    st0 = stacked.init_state()
+
+    def run_stacked():
+        _, out = stacked.scan(st0, sample_events)
+        jax.block_until_ready(out.count)
+
+    t_stacked = best_of(run_stacked)
+    details = {
+        "serial_s": t_serial,
+        "stacked_s": t_stacked,
+        "speedup_stacked": t_serial / t_stacked,
+    }
+    mode = "stacked" if t_stacked <= t_serial else "serial"
+    logger.info("choose_bank: %s (%s)", mode, details)
+    return mode, details

@@ -127,3 +127,51 @@ def test_unstackable_shapes_rejected():
     assert not stackable([lower(p2), lower(p3)])
     with pytest.raises(ValueError, match="stackable"):
         StackedBankMatcher([p2, p3], 8, CFG)
+
+
+def test_choose_bank_modes():
+    """Non-stackable banks are serial by necessity; stackable ones pick by
+    measurement when a sample is given (either answer is legitimate on
+    CPU — the API contract is a working mode plus its evidence)."""
+    import jax.numpy as jnp
+
+    from kafkastreams_cep_tpu.engine import EventBatch
+    from kafkastreams_cep_tpu.parallel.stacked import choose_bank
+
+    def q(i):
+        return (
+            Query()
+            .select("a").where(lambda k, v, ts, st, i=i: v["x"] < 3 + i)
+            .then()
+            .select("b").skip_till_next_match()
+            .where(lambda k, v, ts, st: v["x"] > 6)
+            .build()
+        )
+
+    deep = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] == 0)
+        .then()
+        .select("b").where(lambda k, v, ts, st: v["x"] == 1)
+        .then()
+        .select("c").where(lambda k, v, ts, st: v["x"] == 2)
+        .build()
+    )
+    mode, det = choose_bank([q(0), deep], 8, CFG)
+    assert mode == "serial" and det["reason"] == "not stackable"
+
+    mode, det = choose_bank([q(0), q(1)], 8, CFG)
+    assert mode == "stacked"  # stackable, no sample: one compile beats Q
+
+    K, T = 8, 12
+    xs = np.arange(K * T, dtype=np.int32).reshape(K, T) % 10
+    sample = EventBatch(
+        key=jnp.zeros((K, T), jnp.int32),
+        value={"x": jnp.asarray(xs)},
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+    mode, det = choose_bank([q(0), q(1)], K, CFG, sample, reps=1)
+    assert mode in ("serial", "stacked")
+    assert det["serial_s"] > 0 and det["stacked_s"] > 0
