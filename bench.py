@@ -539,7 +539,7 @@ def bench_bank(n_list, total_lanes, T, reps):
         )
         patterns = [q(i) for i in range(N)]
         sample = jax.tree_util.tree_map(lambda x: x[:128], events)
-        mode, det = choose_bank(patterns, 128, cfg, sample, reps=1)
+        mode, det = choose_bank(patterns, cfg, sample, reps=1)
 
         t0 = time.perf_counter()
         matchers = [BatchMatcher(p, K, cfg) for p in patterns]

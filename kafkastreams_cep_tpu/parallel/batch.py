@@ -201,8 +201,63 @@ class BatchMatcher:
         else:
             self._step_fn = lane_step(self.matcher._step_fn)
             self._scan_fn = lane_scan(self.matcher._step_fn)
+        # Whole-scan fused kernel (ops/scan_kernel.py): the entire event
+        # loop in one Pallas program, state resident in VMEM across T.
+        # Opt-in (CEP_SCAN_KERNEL=1, or =interpret for CPU testing):
+        # differential parity is pinned by tests/test_scan_kernel.py, and
+        # measured throughput is at parity with the per-step walk kernel
+        # on the headline trace (see PROFILE_r05.md — both are bound by
+        # the same lockstep walk-pass vector work, not launch or HBM
+        # overheads), so the per-step path stays the default.
+        self.uses_scan_kernel = False
+        scan_mode = os.environ.get("CEP_SCAN_KERNEL", "0")
+        if scan_mode in ("1", "interpret"):
+            from kafkastreams_cep_tpu.ops import scan_kernel
+
+            if self.num_lanes % scan_kernel.LANE_BLOCK:
+                logger.warning(
+                    "CEP_SCAN_KERNEL=%s requested but num_lanes=%d is not "
+                    "a multiple of %d — using the per-step path",
+                    scan_mode, self.num_lanes, scan_kernel.LANE_BLOCK,
+                )
+            else:
+                full = scan_kernel.build_scan(
+                    self.matcher.tables, self.matcher.config
+                )
+                full.interpret = scan_mode == "interpret"
+                self._scan_fn = self._with_fallback(full)
+                self.uses_scan_kernel = True
+                logger.info("batch matcher: whole-scan kernel enabled")
         self.step = jax.jit(self._step_fn)
-        self.scan = jax.jit(self._scan_fn)
+        self.scan = jax.jit(self._scan_fn) if not self.uses_scan_kernel \
+            else self._scan_fn
+
+    def _with_fallback(self, full_scan):
+        """The whole-scan kernel traces user predicates INTO the Pallas
+        program, so a pattern that doesn't lower to Mosaic fails at the
+        first compiled call, not at build time — catch that call and
+        permanently fall back to the per-step path."""
+        fast = jax.jit(full_scan)
+        slow = None
+
+        def scan(state, events):
+            nonlocal slow
+            if slow is None:
+                try:
+                    return fast(state, events)
+                except Exception as e:
+                    logger.warning(
+                        "whole-scan kernel failed to lower (%s); falling "
+                        "back to the per-step path", e,
+                    )
+                    self.uses_scan_kernel = False
+                    if self.uses_walk_kernel:
+                        slow = jax.jit(kernel_lane_scan(self._step_fn))
+                    else:
+                        slow = jax.jit(lane_scan(self.matcher._step_fn))
+            return slow(state, events)
+
+        return scan
 
     @property
     def names(self):

@@ -145,7 +145,6 @@ class StackedBankMatcher:
 
 def choose_bank(
     patterns: Sequence,
-    lanes_per_query: int,
     config: Optional[EngineConfig] = None,
     sample_events: Optional[EventBatch] = None,
     reps: int = 2,

@@ -1,0 +1,203 @@
+"""Whole-scan fused kernel (``ops/scan_kernel.py``) vs the jnp engine.
+
+The scan kernel reimplements every engine phase (predicates, chain,
+folds, puts, walks, compaction) as one Pallas program with state resident
+across the time axis; these tests pin bit-exact parity of outputs AND the
+full engine state (run queue, slab, counters) against ``BatchMatcher``'s
+reference path, in interpreter mode on the CPU suite, across the
+behaviors that have historically diverged first: kleene branching under
+skip_till_any, typed (float) folds, padding steps, version overflow, and
+state carried across multiple scans.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import Query
+from kafkastreams_cep_tpu.compiler.tables import lower
+from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.ops.scan_kernel import build_scan
+from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+
+K = 128  # one lane block
+
+
+def events_of(xs, valid=None, ts_mult=1):
+    K_, T = xs.shape
+    return EventBatch(
+        key=jnp.broadcast_to(jnp.arange(K_, dtype=jnp.int32)[:, None], (K_, T)),
+        value={"x": jnp.asarray(xs)},
+        ts=jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :] * ts_mult, (K_, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K_, T)),
+        valid=jnp.ones((K_, T), bool) if valid is None else jnp.asarray(valid),
+    )
+
+
+def assert_state_equal(st_k, st_ref):
+    for name in ("alive", "id_pos", "eval_pos", "vlen", "event_off",
+                 "start_ts", "branching", "agg", "ver", "run_drops",
+                 "ver_overflows"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_k, name)),
+            np.asarray(getattr(st_ref, name)), err_msg=name,
+        )
+    for name in ("stage", "off", "refs", "npreds", "full_drops",
+                 "pred_drops", "missing", "trunc"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_k.slab, name)),
+            np.asarray(getattr(st_ref.slab, name)), err_msg=f"slab.{name}",
+        )
+
+
+def run_both(pattern, cfg, events, n_scans=1):
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    batch = BatchMatcher(pattern, K, cfg)
+    scan = build_scan(lower(pattern), cfg)
+    scan.interpret = True
+    st_r = st_k = batch.init_state()
+    for _ in range(n_scans):
+        st_r, out_r = batch.scan(st_r, events)
+        st_k, out_k = scan(st_k, events)
+        np.testing.assert_array_equal(
+            np.asarray(out_k.count), np.asarray(out_r.count))
+        np.testing.assert_array_equal(
+            np.asarray(out_k.stage), np.asarray(out_r.stage))
+        np.testing.assert_array_equal(
+            np.asarray(out_k.off), np.asarray(out_r.off))
+        # Offsets must advance across scans for a valid multi-scan replay.
+        events = events._replace(off=events.off + int(events.off.shape[1]))
+    assert_state_equal(st_k, st_r)
+
+
+def test_stock_pattern_with_padding():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "examples"))
+    import stock_demo
+
+    cfg = EngineConfig(
+        max_runs=8, slab_entries=24, slab_preds=4, dewey_depth=8, max_walk=8
+    )
+    rng = np.random.default_rng(3)
+    T = 12
+    prices = rng.integers(90, 131, size=(K, T)).astype(np.int32)
+    volumes = rng.integers(600, 1101, size=(K, T)).astype(np.int32)
+    valid = np.ones((K, T), bool)
+    valid[:, -2:] = False
+    valid[::3, 5] = False  # per-lane padding holes
+    events = EventBatch(
+        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
+        value={"price": jnp.asarray(prices), "volume": jnp.asarray(volumes)},
+        ts=jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :] * 2, (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+        valid=jnp.asarray(valid),
+    )
+    run_both(stock_demo.stock_pattern(), cfg, events)
+
+
+def test_kleene_any_branching_two_scans():
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] == 0)
+        .then()
+        .select("b").one_or_more().skip_till_any_match()
+        .where(lambda k, v, ts, st: (0 < v["x"]) & (v["x"] < 8))
+        .then()
+        .select("c").where(lambda k, v, ts, st: v["x"] >= 8)
+        .build()
+    )
+    cfg = EngineConfig(
+        max_runs=16, slab_entries=32, slab_preds=6, dewey_depth=10,
+        max_walk=12,
+    )
+    rng = np.random.default_rng(7)
+    xs = rng.choice([0, 1, 2, 3, 9, 9], size=(K, 16)).astype(np.int32)
+    run_both(pattern, cfg, events_of(xs), n_scans=2)
+
+
+def test_typed_float_folds():
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] > 0)
+        .fold("ema", lambda k, v, curr: 0.5 * curr + 0.25 * v["x"], init=0.0)
+        .fold("n", lambda k, v, curr: curr + 1, init=0)
+        .then()
+        .select("b").skip_till_next_match()
+        .where(lambda k, v, ts, st: (st.get("ema") > 0.7) & (st.get("n") > 1))
+        .build()
+    )
+    cfg = EngineConfig(
+        max_runs=8, slab_entries=24, slab_preds=4, dewey_depth=8, max_walk=8
+    )
+    rng = np.random.default_rng(11)
+    xs = rng.integers(0, 6, size=(K, 14)).astype(np.int32)
+    run_both(pattern, cfg, events_of(xs))
+
+
+def test_version_overflow_counted_identically():
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] == 0)
+        .then()
+        .select("b").zero_or_more().skip_till_next_match()
+        .where(lambda k, v, ts, st: (0 < v["x"]) & (v["x"] < 6))
+        .then()
+        .select("c").skip_till_next_match()
+        .where(lambda k, v, ts, st: v["x"] == 7)
+        .build()
+    )
+    cfg = EngineConfig(
+        max_runs=8, slab_entries=24, slab_preds=4, dewey_depth=4,
+        max_walk=12, renorm_versions=False,
+    )
+    xs = np.asarray(
+        [[0] + [6] * 10 + [1, 6, 7, 6, 6]] * K, dtype=np.int32
+    )
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    batch = BatchMatcher(pattern, K, cfg)
+    st_r, _ = batch.scan(batch.init_state(), events_of(xs))
+    assert int(jnp.sum(st_r.ver_overflows)) > 0  # the trace really overflows
+    run_both(pattern, cfg, events_of(xs))
+
+
+def test_enforce_windows_mode():
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] == 1)
+        .then()
+        .select("b").skip_till_next_match()
+        .where(lambda k, v, ts, st: v["x"] == 2)
+        .within(5, "ms")
+        .build()
+    )
+    cfg = EngineConfig(
+        max_runs=8, slab_entries=24, slab_preds=4, dewey_depth=8,
+        max_walk=8, enforce_windows=True,
+    )
+    rng = np.random.default_rng(13)
+    xs = rng.integers(0, 4, size=(K, 16)).astype(np.int32)
+    run_both(pattern, cfg, events_of(xs, ts_mult=3))
+
+
+def test_strict_contiguity_chain():
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] == 1)
+        .then()
+        .select("b").where(lambda k, v, ts, st: v["x"] == 2)
+        .then()
+        .select("c").where(lambda k, v, ts, st: v["x"] == 3)
+        .build()
+    )
+    cfg = EngineConfig(
+        max_runs=8, slab_entries=24, slab_preds=4, dewey_depth=8, max_walk=8
+    )
+    rng = np.random.default_rng(17)
+    xs = rng.integers(0, 5, size=(K, 16)).astype(np.int32)
+    run_both(pattern, cfg, events_of(xs))

@@ -157,10 +157,10 @@ def test_choose_bank_modes():
         .select("c").where(lambda k, v, ts, st: v["x"] == 2)
         .build()
     )
-    mode, det = choose_bank([q(0), deep], 8, CFG)
+    mode, det = choose_bank([q(0), deep], CFG)
     assert mode == "serial" and det["reason"] == "not stackable"
 
-    mode, det = choose_bank([q(0), q(1)], 8, CFG)
+    mode, det = choose_bank([q(0), q(1)], CFG)
     assert mode == "stacked"  # stackable, no sample: one compile beats Q
 
     K, T = 8, 12
@@ -172,6 +172,6 @@ def test_choose_bank_modes():
         off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (K, T)),
         valid=jnp.ones((K, T), bool),
     )
-    mode, det = choose_bank([q(0), q(1)], K, CFG, sample, reps=1)
+    mode, det = choose_bank([q(0), q(1)], CFG, sample, reps=1)
     assert mode in ("serial", "stacked")
     assert det["serial_s"] > 0 and det["stacked_s"] > 0
