@@ -89,6 +89,21 @@ class ShardedMatcher:
         else:
             local_step = lane_step(self.matcher._step_fn)
             local_scan = lane_scan(self.matcher._step_fn)
+        # Whole-scan kernel inside shard_map (opt-in, same knob as
+        # BatchMatcher): lanes never cross shards, so each shard's block
+        # is an ordinary lane batch for the fused program.
+        self.uses_scan_kernel = False
+        scan_mode = __import__("os").environ.get("CEP_SCAN_KERNEL", "0")
+        if scan_mode in ("1", "interpret"):
+            from kafkastreams_cep_tpu.ops import scan_kernel
+
+            if (self.num_lanes // n) % scan_kernel.LANE_BLOCK == 0:
+                full = scan_kernel.build_scan(
+                    self.matcher.tables, self.matcher.config
+                )
+                full.interpret = scan_mode == "interpret"
+                local_scan = full
+                self.uses_scan_kernel = True
 
         def local_stats(state):
             local = jnp.stack(

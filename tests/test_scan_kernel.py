@@ -201,3 +201,47 @@ def test_strict_contiguity_chain():
     rng = np.random.default_rng(17)
     xs = rng.integers(0, 5, size=(K, 16)).astype(np.int32)
     run_both(pattern, cfg, events_of(xs))
+
+
+def test_scan_kernel_inside_shard_map():
+    """Pallas-inside-shard_map for the whole-scan kernel: 8 shards x 128
+    lanes each, emissions identical to the sharded jnp path."""
+    from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher, key_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    pattern = (
+        Query()
+        .select("a").where(lambda k, v, ts, st: v["x"] < 3)
+        .then()
+        .select("b").skip_till_next_match()
+        .where(lambda k, v, ts, st: v["x"] > 6)
+        .build()
+    )
+    cfg = EngineConfig(
+        max_runs=8, slab_entries=24, slab_preds=4, dewey_depth=8, max_walk=8
+    )
+    KS = 128 * 8
+    rng = np.random.default_rng(23)
+    xs = rng.integers(0, 10, size=(KS, 8)).astype(np.int32)
+    mesh = key_mesh(jax.devices()[:8])
+
+    os.environ["CEP_SCAN_KERNEL"] = "0"
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    ref = ShardedMatcher(pattern, KS, mesh, cfg)
+    assert not ref.uses_scan_kernel
+    events = events_of(xs)
+    st_r, out_r = ref.scan(ref.init_state(), ref.shard_events(events))
+
+    os.environ["CEP_SCAN_KERNEL"] = "interpret"
+    try:
+        krn = ShardedMatcher(pattern, KS, mesh, cfg)
+        assert krn.uses_scan_kernel
+        st_k, out_k = krn.scan(krn.init_state(), krn.shard_events(events))
+    finally:
+        os.environ["CEP_SCAN_KERNEL"] = "0"
+    np.testing.assert_array_equal(
+        np.asarray(out_k.count), np.asarray(out_r.count))
+    np.testing.assert_array_equal(
+        np.asarray(out_k.stage), np.asarray(out_r.stage))
+    assert krn.stats(st_k) == ref.stats(st_r)
