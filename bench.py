@@ -369,6 +369,33 @@ def bench_engine(K, T, reps):
             f"oracle on {len(lanes)} sampled lanes ({n_oracle} oracle "
             f"matches, {time.perf_counter() - t0:.1f}s)"
         )
+        # Recall is a capacity knob, not an engine property: one larger
+        # configuration shows the throughput/recall tradeoff on the same
+        # trace (CEP_BENCH_RECALL_CURVE=0 skips).
+        if os.environ.get("CEP_BENCH_RECALL_CURVE", "1") != "0":
+            big = EngineConfig(
+                max_runs=64, slab_entries=128, slab_preds=8,
+                dewey_depth=16, max_walk=16,
+            )
+            bb = BatchMatcher(stock_demo.stock_pattern(), K, big)
+            bs0 = bb.init_state()
+            bstate, bout = bb.scan(bs0, events)
+            jax.block_until_ready(bout.count)
+            bbest = float("inf")
+            for _ in range(max(reps - 2, 1)):
+                t0 = time.perf_counter()
+                bstate, bout = bb.scan(bs0, events)
+                jax.block_until_ready(bout.count)
+                bbest = min(bbest, time.perf_counter() - t0)
+            r2, p2, _ = measure_recall(
+                bout, bb.names, prices, volumes, lanes
+            )
+            log(
+                f"engine[R=64,E=128,W=16]: {K * T / bbest / 1e3:.0f}K ev/s, "
+                f"recall {r2:.4f} / precision {p2:.4f} — the capacity/"
+                "recall tradeoff on the same trace"
+            )
+            del bb, bs0, bstate, bout
     return K * T / best, spread, counters, recall, precision
 
 
@@ -674,7 +701,14 @@ def bench_processor(K, T, n_batches):
     N = K * T
     keys = np.tile(np.arange(K, dtype=np.int64), T)
     prices = rng.integers(90, 131, size=N).astype(np.int64)
-    volumes = rng.integers(600, 1101, size=N).astype(np.int64)
+    # ~1.5% of volumes cross the 1000 begin threshold: realistic match
+    # density (~0.1% of events complete a match).  The headline trace's
+    # adversarial density (~25% of events) measures Python match-object
+    # materialization, not the pipeline — every emitted match is a
+    # contractual host Sequence either way, so a dense stream is bounded
+    # by emission, here by transport/packing overlap (what this line is
+    # for; the engine-vs-oracle numbers cover matching cost).
+    volumes = rng.integers(600, 1016, size=N).astype(np.int64)
 
     def feed(b):
         ts = np.int64(b) * N + np.arange(N, dtype=np.int64)
@@ -697,8 +731,9 @@ def bench_processor(K, T, n_batches):
         f"processor (pipelined columnar, {K} lanes x {T} ev x "
         f"{n_batches} batches): {n_batches * N / dt / 1e3:.0f}K ev/s "
         f"end-to-end, {n_matches} matches, decode_fallbacks "
-        f"{snap['decode_fallbacks']}, device {snap['device_seconds']:.2f}s "
-        f"decode {snap['decode_seconds']:.2f}s of {dt:.2f}s wall"
+        f"{snap['decode_fallbacks']}, wall {dt:.2f}s (pipelined sections "
+        f"overlap: device {snap['device_seconds']:.2f}s + decode "
+        f"{snap['decode_seconds']:.2f}s measured independently)"
     )
     return n_batches * N / dt
 
@@ -790,11 +825,12 @@ def main():
                 "sharded-folds",
                 lambda: bench_sharded_folds(
                     # 262144 lanes fit the round-4 hand config; the derived
-                    # loss-free config is larger per lane (D=24, E/MP from
-                    # the probe — 131072 lanes RESOURCE_EXHAUSTED on v5e),
-                    # so the default quarters to keep slab HBM in budget.
-                    # Throughput is per-event, not per-lane-count.
-                    int(os.environ.get("CEP_BENCH_SHARD_K", "65536")),
+                    # loss-free config is larger per lane (D=24, MP=16 from
+                    # the probe — 65536 lanes still RESOURCE_EXHAUSTED on a
+                    # v5e chip shared with earlier extras), so the default
+                    # drops to 32768.  Throughput is per-event, not
+                    # per-lane-count.
+                    int(os.environ.get("CEP_BENCH_SHARD_K", "32768")),
                     int(os.environ.get("CEP_BENCH_SHARD_T", "16")),
                     max(reps - 1, 1),
                 ),
@@ -808,6 +844,8 @@ def main():
                 ),
             ),
         ]
+        import gc
+
         for name, fn in extras:
             if time.perf_counter() - t_start > budget:
                 log(f"{name}: skipped (past {budget:.0f}s bench budget)")
@@ -816,6 +854,10 @@ def main():
                 fn()
             except Exception as e:  # extras never break the headline line
                 log(f"{name} bench failed: {type(e).__name__}: {e}")
+            # Drop the extra's device arrays before the next one compiles
+            # (a prior extra's live buffers have caused RESOURCE_EXHAUSTED
+            # cascades on the shared chip).
+            gc.collect()
 
     print(
         json.dumps(

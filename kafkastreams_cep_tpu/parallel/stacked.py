@@ -164,6 +164,16 @@ def choose_bank(
     default to stacked (the single-compile saving alone is decisive for
     short streams — a serial bank compiles once per query).
 
+    Measured finding (v5e, BENCH_r05): at production widths (>=6400
+    lanes/query) serial wins steady-state at every benched bank width
+    (fused at 0.79-0.91x for N=2/8/16) — per-dispatch overhead is
+    negligible at those widths while the stacked step pays every query's
+    predicate work on every lane; fused wins compile time 2-4x (one
+    program vs N).  Size the sample near the deployment's per-query
+    width: a 128-lane sample once picked stacked for an N=8 bank whose
+    12800-lane-per-query deployment favored serial, because dispatch
+    overhead dominates at sample width.
+
     Returns ``(mode, details)`` with measured rates in ``details`` when a
     sample was timed."""
     import time
