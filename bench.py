@@ -371,31 +371,41 @@ def bench_engine(K, T, reps):
         )
         # Recall is a capacity knob, not an engine property: one larger
         # configuration shows the throughput/recall tradeoff on the same
-        # trace (CEP_BENCH_RECALL_CURVE=0 skips).
+        # trace (CEP_BENCH_RECALL_CURVE=0 skips).  Runs on a 1024-lane
+        # slice — the R=64/W=16 match outputs at the full lane count are
+        # multi-GB (a full-shape attempt RESOURCE_EXHAUSTED the chip) and
+        # the per-event rate + sampled recall don't need more lanes.
         if os.environ.get("CEP_BENCH_RECALL_CURVE", "1") != "0":
-            big = EngineConfig(
-                max_runs=64, slab_entries=128, slab_preds=8,
-                dewey_depth=16, max_walk=16,
-            )
-            bb = BatchMatcher(stock_demo.stock_pattern(), K, big)
-            bs0 = bb.init_state()
-            bstate, bout = bb.scan(bs0, events)
-            jax.block_until_ready(bout.count)
-            bbest = float("inf")
-            for _ in range(max(reps - 2, 1)):
-                t0 = time.perf_counter()
-                bstate, bout = bb.scan(bs0, events)
+            try:
+                K2 = min(K, 1024)
+                ev2 = jax.tree_util.tree_map(lambda x: x[:K2], events)
+                lanes2 = [l for l in lanes if l < K2] or [0]
+                big = EngineConfig(
+                    max_runs=64, slab_entries=128, slab_preds=8,
+                    dewey_depth=16, max_walk=16,
+                )
+                bb = BatchMatcher(stock_demo.stock_pattern(), K2, big)
+                bs0 = bb.init_state()
+                bstate, bout = bb.scan(bs0, ev2)
                 jax.block_until_ready(bout.count)
-                bbest = min(bbest, time.perf_counter() - t0)
-            r2, p2, _ = measure_recall(
-                bout, bb.names, prices, volumes, lanes
-            )
-            log(
-                f"engine[R=64,E=128,W=16]: {K * T / bbest / 1e3:.0f}K ev/s, "
-                f"recall {r2:.4f} / precision {p2:.4f} — the capacity/"
-                "recall tradeoff on the same trace"
-            )
-            del bb, bs0, bstate, bout
+                bbest = float("inf")
+                for _ in range(max(reps - 2, 1)):
+                    t0 = time.perf_counter()
+                    bstate, bout = bb.scan(bs0, ev2)
+                    jax.block_until_ready(bout.count)
+                    bbest = min(bbest, time.perf_counter() - t0)
+                r2, p2, _ = measure_recall(
+                    bout, bb.names, prices, volumes, lanes2
+                )
+                log(
+                    f"engine[R=64,E=128,W=16, {K2} lanes]: "
+                    f"{K2 * T / bbest / 1e3:.0f}K ev/s, recall {r2:.4f} / "
+                    f"precision {p2:.4f} — the capacity/recall tradeoff "
+                    "on the same trace"
+                )
+                del bb, bs0, bstate, bout
+            except Exception as e:  # never break the headline
+                log(f"recall-curve point failed: {type(e).__name__}: {e}")
     return K * T / best, spread, counters, recall, precision
 
 
@@ -641,12 +651,15 @@ def bench_sharded_folds(K, T, reps):
     # DERIVED from a 128-lane probe of the same trace so the measured
     # number is overflow- and capacity-drop-free.
     if os.environ.get("CEP_BENCH_AUTOSIZE", "1") != "0":
-        sample = jax.tree_util.tree_map(lambda x: x[:min(K, 128)], host_events)
+        # 512-lane sample: a 128-lane probe missed a rare pointer-width
+        # peak at 32768 lanes (slab_pred_drops 2 in 524K events); rare
+        # maxima need a sample big enough to contain them.
+        sample = jax.tree_util.tree_map(lambda x: x[:min(K, 512)], host_events)
         cfg = autosize(
             stock_demo.stock_pattern(), sample,
             start=EngineConfig(max_runs=8, slab_entries=16, slab_preds=4,
                                dewey_depth=24, max_walk=8),
-            margin=1.4, sweep_every=T,
+            margin=1.5, sweep_every=T,
         )
         log(f"sharded-folds: autosized config {cfg}")
     else:
@@ -695,20 +708,22 @@ def bench_processor(K, T, n_batches):
     )
     proc = CEPProcessor(
         stock_demo.stock_pattern(), K, cfg, epoch=0, pipeline=True,
-        decode_budget=int(os.environ.get("CEP_BENCH_DECODE_BUDGET", "512")),
+        decode_budget=int(os.environ.get("CEP_BENCH_DECODE_BUDGET", "131072")),
     )
     rng = np.random.default_rng(23)
     N = K * T
     keys = np.tile(np.arange(K, dtype=np.int64), T)
     prices = rng.integers(90, 131, size=N).astype(np.int64)
-    # ~1.5% of volumes cross the 1000 begin threshold: realistic match
-    # density (~0.1% of events complete a match).  The headline trace's
-    # adversarial density (~25% of events) measures Python match-object
-    # materialization, not the pipeline — every emitted match is a
-    # contractual host Sequence either way, so a dense stream is bounded
-    # by emission, here by transport/packing overlap (what this line is
-    # for; the engine-vs-oracle numbers cover matching cost).
-    volumes = rng.integers(600, 1016, size=N).astype(np.int64)
+    # Calibrated to ~1% match rate (0.5% begin spikes over a sub-
+    # threshold base; the converging avg fold otherwise keeps every begun
+    # lineage matching repeatedly — the headline trace's 139% match rate
+    # measures Python match-object materialization, not the pipeline.
+    # Every emitted match is a contractual host Sequence either way; this
+    # line is about transport/packing/decode overlap, and the
+    # engine-vs-oracle numbers cover matching cost).
+    volumes = np.where(
+        rng.random(N) < 0.005, 1100, rng.integers(700, 1000, size=N)
+    ).astype(np.int64)
 
     def feed(b):
         ts = np.int64(b) * N + np.arange(N, dtype=np.int64)

@@ -28,43 +28,39 @@ import jax.numpy as jnp
 
 @functools.partial(jax.jit, static_argnames=("budget",))
 def compact_matches(out, budget: int):
-    """``StepOutput [K, T, R, ...]`` -> compacted per-lane match rows.
+    """``StepOutput [K, T, R, ...]`` -> globally compacted match rows.
 
-    Returns ``(stage [K, M, W], off [K, M, W], count [K, M], t [K, M],
-    r [K, M], overflow [] bool)`` with hit rows first in (t, r) scan
-    order; rows past a lane's hit count carry ``count == 0``.
+    Returns ``(stage [G, W], off [G, W], count [G], k [G], t [G], r [G],
+    overflow [] bool)`` with the hit rows first in (k, t, r) order and
+    ``count == 0`` rows past the total hit count.  Compaction is global
+    across lanes (one stable sort over the flattened grid): the host pull
+    is then proportional to the match *budget*, not ``lanes x budget`` —
+    on a tunneled device the transfer is the decode wall, and a per-lane
+    layout was measured pulling ~200 MB/batch for ~18K actual matches.
     """
     K, T, R = out.count.shape
     W = out.stage.shape[-1]
-    M = min(budget, T * R)
+    N = K * T * R
+    G = min(budget, N)
     i32 = jnp.int32
 
-    count = out.count.reshape(K, T * R)
+    count = out.count.reshape(N)
     hit = count > 0
-    n_hits = jnp.sum(hit.astype(i32), axis=1)  # [K]
-    overflow = jnp.any(n_hits > M)
+    n_hits = jnp.sum(jnp.where(hit, 1, 0))
+    overflow = n_hits > G
 
-    # Stable sort on the miss flag floats hits to the front in scan order.
+    # Stable sort on the miss flag floats hits to the front, preserving
+    # (k, t, r) order among them.
     order = jnp.argsort(
-        jnp.where(hit, 0, 1).astype(i32), axis=1, stable=True
-    )[:, :M]  # [K, M]
+        jnp.where(hit, 0, 1).astype(i32), stable=True
+    )[:G]  # [G]
 
-    def rows(field):  # [K, T, R, W] -> [K, M, W]
-        return jnp.take_along_axis(
-            field.reshape(K, T * R, W), order[:, :, None], axis=1
-        )
-
-    def scalars(field):  # [K, N] -> [K, M]
-        return jnp.take_along_axis(field, order, axis=1)
-
-    n = jnp.arange(T * R, dtype=i32)
-    t_of = jnp.broadcast_to((n // R)[None, :], (K, T * R))
-    r_of = jnp.broadcast_to((n % R)[None, :], (K, T * R))
     return (
-        rows(out.stage),
-        rows(out.off),
-        scalars(count),
-        scalars(t_of),
-        scalars(r_of),
+        out.stage.reshape(N, W)[order],
+        out.off.reshape(N, W)[order],
+        count[order],
+        (order // (T * R)).astype(i32),
+        ((order // R) % T).astype(i32),
+        (order % R).astype(i32),
         overflow,
     )

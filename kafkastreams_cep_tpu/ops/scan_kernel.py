@@ -78,14 +78,6 @@ def _sel_table(table: np.ndarray, idx):
     return out
 
 
-def _sel_list(values: List[Any], idx, fill):
-    """``values[idx]`` for a short list of same-shape traced arrays."""
-    out = jnp.full_like(values[0], fill) if values else None
-    for p, v in enumerate(values):
-        out = jnp.where(idx == p, v, out)
-    return out
-
-
 def build_scan(tables, config: EngineConfig):
     """A jitted ``scan(state, events) -> (state, outs)`` over the fused
     whole-scan kernel, or raise if the pattern cannot lower.

@@ -175,7 +175,7 @@ def restore_processor(
         dedup=header.get("dedup", True),
         gc_interval=header.get("gc_interval", 0),
         gc_events_interval=header.get("gc_events_interval", 8),
-        decode_budget=header.get("decode_budget", 128),
+        decode_budget=header.get("decode_budget", 131072),
         pipeline=header.get("pipeline", False),
         mesh=mesh,
     )

@@ -113,7 +113,7 @@ class CEPProcessor:
         dedup: bool = True,
         gc_interval: int = 16,
         gc_events_interval: int = 8,
-        decode_budget: int = 128,
+        decode_budget: int = 131072,
         pipeline: bool = False,
         mesh=None,
     ):
@@ -145,8 +145,10 @@ class CEPProcessor:
         # keys + run state; amortizing it every N batches keeps the host
         # mirror bounded without a per-batch sync (VERDICT round-4 item 9).
         self.gc_events_interval = max(int(gc_events_interval), 1)
-        # Per-lane rows of the compacted decode pull (0 = always pull the
-        # raw [K, T, R, W] grid); see _decode.
+        # Total compacted match rows the decode pulls per batch (0 =
+        # always pull the raw [K, T, R, W] grid); batches with more
+        # matches than the budget fall back to the full pull, counted in
+        # ``metrics.decode_fallbacks``.  See _decode / ops/decode.py.
         self.decode_budget = int(decode_budget)
         # Pipelined mode (SURVEY §2.2 PP row — the fetch-ahead overlap the
         # reference gets from Kafka Streams' poll loop): process() returns
@@ -605,21 +607,21 @@ class CEPProcessor:
         if self.decode_budget:
             from kafkastreams_cep_tpu.ops.decode import compact_matches
 
-            c_stage, c_off, c_count, c_t, c_r, overflow = compact_matches(
-                out, self.decode_budget
+            c_stage, c_off, c_count, c_k, c_t, c_r, overflow = (
+                compact_matches(out, self.decode_budget)
             )
             if not bool(overflow):
-                # One transfer for all five arrays — per-pull latency is
+                # One transfer for all six arrays — pull latency is
                 # exactly what this path exists to avoid.
-                count, stage, off, t_arr, r_arr = jax.device_get(
-                    (c_count, c_stage, c_off, c_t, c_r)
+                count, stage, off, k_arr, t_arr, r_arr = jax.device_get(
+                    (c_count, c_stage, c_off, c_k, c_t, c_r)
                 )
-                ks, ms = np.nonzero(count)
-                if ks.size == 0:
+                (hits,) = np.nonzero(count)
+                if hits.size == 0:
                     return []
                 return self._emit(
-                    ks, t_arr[ks, ms], r_arr[ks, ms], count[ks, ms],
-                    stage[ks, ms], off[ks, ms], rank_of,
+                    k_arr[hits], t_arr[hits], r_arr[hits], count[hits],
+                    stage[hits], off[hits], rank_of,
                 )
             self.metrics.decode_fallbacks += 1
         stage = np.asarray(jax.device_get(out.stage))  # [K, T, R, W]

@@ -315,7 +315,7 @@ def test_compacted_decode_matches_full_pull():
     full = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg(),
                         decode_budget=0)
     fast = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg(),
-                        decode_budget=128)
+                        decode_budget=4096)
     tiny = CEPProcessor(stock_demo.stock_pattern(), 8, stock_cfg(),
                         decode_budget=1)
     want = _fmt_all(_run_batches(full, batches))
