@@ -31,12 +31,15 @@ def compact_matches(out, budget: int):
     """``StepOutput [K, T, R, ...]`` -> globally compacted match rows.
 
     Returns ``(stage [G, W], off [G, W], count [G], k [G], t [G], r [G],
-    overflow [] bool)`` with the hit rows first in (k, t, r) order and
-    ``count == 0`` rows past the total hit count.  Compaction is global
-    across lanes (one stable sort over the flattened grid): the host pull
-    is then proportional to the match *budget*, not ``lanes x budget`` —
-    on a tunneled device the transfer is the decode wall, and a per-lane
-    layout was measured pulling ~200 MB/batch for ~18K actual matches.
+    n_hits [], overflow [] bool)`` with the hit rows first in (k, t, r)
+    order and ``count == 0`` rows past the total hit count.  Compaction
+    is global across lanes (one stable sort over the flattened grid): the
+    host pull is then proportional to the match *budget*, not ``lanes x
+    budget`` — on a tunneled device the transfer is the decode wall, and
+    a per-lane layout was measured pulling ~200 MB/batch for ~18K actual
+    matches.  ``n_hits`` lets the caller slice the rows to the actual
+    match count before pulling (two-phase pull: one scalar, then
+    ``rows[:n]``).
     """
     K, T, R = out.count.shape
     W = out.stage.shape[-1]
@@ -49,18 +52,28 @@ def compact_matches(out, budget: int):
     n_hits = jnp.sum(jnp.where(hit, 1, 0))
     overflow = n_hits > G
 
-    # Stable sort on the miss flag floats hits to the front, preserving
-    # (k, t, r) order among them.
-    order = jnp.argsort(
-        jnp.where(hit, 0, 1).astype(i32), stable=True
-    )[:G]  # [G]
+    # Rank-scatter, not sort: a full argsort over the N-row grid was
+    # measured at seconds per batch on TPU; an exclusive prefix sum plus
+    # one masked scatter is linear and keeps (k, t, r) order (ranks are
+    # monotone).  Non-hits scatter to index G, dropped by mode="drop".
+    rank = jnp.cumsum(jnp.where(hit, 1, 0)) - 1
+    dst = jnp.where(hit, rank, G).astype(i32)
 
+    def scat(flat, width=None):
+        if width is None:
+            z = jnp.zeros((G,), flat.dtype)
+            return z.at[dst].set(flat, mode="drop")
+        z = jnp.zeros((G, width), flat.dtype)
+        return z.at[dst].set(flat, mode="drop")
+
+    n = jnp.arange(N, dtype=i32)
     return (
-        out.stage.reshape(N, W)[order],
-        out.off.reshape(N, W)[order],
-        count[order],
-        (order // (T * R)).astype(i32),
-        ((order // R) % T).astype(i32),
-        (order % R).astype(i32),
+        scat(out.stage.reshape(N, W), W),
+        scat(out.off.reshape(N, W), W),
+        scat(count),
+        scat(n // (T * R)),
+        scat((n // R) % T),
+        scat(n % R),
+        n_hits,
         overflow,
     )

@@ -42,7 +42,10 @@ logger = get_logger("runtime.checkpoint")
 # silently cast.
 # v3: headers record state_dtypes so a fold dtype flip between save and
 # restore is refused; v2 files lack the record and cannot be checked, so
-# they are refused too (same no-silent-reinterpretation rule).
+# they are refused too (same no-silent-reinterpretation rule).  v3's
+# decode_budget header field is the processor's GLOBAL compacted-row
+# budget (runtime/processor.py) — no earlier released format carried a
+# per-lane meaning.
 FORMAT_VERSION = 3
 
 

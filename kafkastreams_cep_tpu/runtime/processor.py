@@ -595,33 +595,44 @@ class CEPProcessor:
     def _decode(self, out, rank_of) -> List[Tuple[Hashable, Sequence]]:
         """Device walk outputs -> (key, Sequence), in arrival order.
 
-        Fast path: the match rows compact on-device into
-        ``decode_budget`` rows per lane (``ops/decode.py``), so the host
-        pulls megabytes instead of the raw ``[K, T, R, W]`` grid —
-        gigabytes at production shapes, and the processor's former
-        critical-path wall (SURVEY §2.2 PP row).  A lane with more hits
-        than the budget falls back to the full pull for that batch
-        (counted in ``decode_fallbacks``; correctness never depends on
-        the budget).
+        Fast path: the batch's match rows compact on-device into a GLOBAL
+        budget of ``decode_budget`` rows across all lanes
+        (``ops/decode.py``), so the host pulls kilobytes-to-megabytes
+        proportional to the actual match count instead of the raw
+        ``[K, T, R, W]`` grid — gigabytes at production shapes, and the
+        processor's former critical-path wall (SURVEY §2.2 PP row).  A
+        batch with more total matches than the budget falls back to the
+        full pull (counted in ``decode_fallbacks``; correctness never
+        depends on the budget).
         """
         if self.decode_budget:
             from kafkastreams_cep_tpu.ops.decode import compact_matches
 
-            c_stage, c_off, c_count, c_k, c_t, c_r, overflow = (
+            K, T, R = out.count.shape
+            c_stage, c_off, c_count, c_k, c_t, c_r, c_n, _overflow = (
                 compact_matches(out, self.decode_budget)
             )
-            if not bool(overflow):
-                # One transfer for all six arrays — pull latency is
-                # exactly what this path exists to avoid.
-                count, stage, off, k_arr, t_arr, r_arr = jax.device_get(
-                    (c_count, c_stage, c_off, c_k, c_t, c_r)
-                )
-                (hits,) = np.nonzero(count)
-                if hits.size == 0:
+            # One scalar round-trip; overflow is host-derivable from it
+            # (an extra device_get costs a full latency floor on tunneled
+            # devices).
+            n = int(c_n)
+            if n <= min(self.decode_budget, K * T * R):
+                if n == 0:
                     return []
+                # Second phase pulls only the hit rows — padded up to a
+                # power of two so slice shapes (and their compiled
+                # executables) are bounded at log2(budget) variants.
+                m = 1
+                while m < n:
+                    m *= 2
+                m = min(m, int(c_count.shape[0]))
+                count, stage, off, k_arr, t_arr, r_arr = jax.device_get(
+                    (c_count[:m], c_stage[:m], c_off[:m], c_k[:m],
+                     c_t[:m], c_r[:m])
+                )
                 return self._emit(
-                    k_arr[hits], t_arr[hits], r_arr[hits], count[hits],
-                    stage[hits], off[hits], rank_of,
+                    k_arr[:n], t_arr[:n], r_arr[:n], count[:n],
+                    stage[:n], off[:n], rank_of,
                 )
             self.metrics.decode_fallbacks += 1
         stage = np.asarray(jax.device_get(out.stage))  # [K, T, R, W]
