@@ -16,10 +16,16 @@ fixed-shape engine state, so
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.parallel.seqpar import TimeShardedStencil
 from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher, key_mesh
+from kafkastreams_cep_tpu.parallel.stacked import (
+    StackedBankMatcher,
+    choose_bank,
+)
 
 __all__ = [
     "BatchMatcher",
     "ShardedMatcher",
+    "StackedBankMatcher",
     "TimeShardedStencil",
+    "choose_bank",
     "key_mesh",
 ]
