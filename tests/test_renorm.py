@@ -266,3 +266,51 @@ def test_long_stream_stays_overflow_free_with_renorm():
         np.testing.assert_array_equal(got.count, want.count)
         np.testing.assert_array_equal(got.off, want.off)
         np.testing.assert_array_equal(got.stage, want.stage)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_renorm_under_branching_matches_oracle_end_to_end(seed):
+    """The sharpest soundness check available: a processor sweeping (and
+    renormalizing) after EVERY batch, on a branching skip_till_any kleene
+    pattern over random traces, must emit exactly the unbounded-version
+    host oracle's matches.  An unsound position deletion would alias
+    sibling versions and change the match set here."""
+    from kafkastreams_cep_tpu import OracleNFA
+    from kafkastreams_cep_tpu.runtime import CEPProcessor, Record
+
+    def pat():
+        return (
+            Query()
+            .select("a").where(lambda k, v, ts, st: v["x"] == 0)
+            .then()
+            .select("b").one_or_more().skip_till_any_match()
+            .where(lambda k, v, ts, st: (0 < v["x"]) & (v["x"] < 8))
+            .then()
+            .select("c").where(lambda k, v, ts, st: v["x"] >= 8)
+            .build()
+        )
+
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=96, slab_preds=8, dewey_depth=10,
+        max_walk=24,
+    )
+    rng = np.random.default_rng(900 + seed)
+    xs = [0] + list(rng.choice([0, 1, 2, 3, 9, 9], size=35))
+    proc = CEPProcessor(pat(), 1, cfg, gc_interval=1, epoch=0)
+    oracle = OracleNFA.from_pattern(pat())
+
+    got, want = [], []
+    for i in range(0, len(xs), 6):  # sweep + renorm every 6 events
+        batch = [Record("k", {"x": int(x)}, 1000 + i + j)
+                 for j, x in enumerate(xs[i:i + 6])]
+        got += [seq.as_map() for _, seq in proc.process(batch)]
+    for i, x in enumerate(xs):
+        want += [m.as_map() for m in oracle.match(
+            "k", {"x": int(x)}, 1000 + i, offset=i)]
+
+    def fmt(ms):
+        return [
+            {n: [e.offset for e in evs] for n, evs in m.items()} for m in ms
+        ]
+
+    assert fmt(got) == fmt(want), f"seed={seed}"
