@@ -24,7 +24,9 @@ zero-counters staircase line; parity replays one lane through the host
 oracle, ~2 min), ``CEP_BENCH_STENCIL_N`` / ``CEP_BENCH_STENCIL_INNER``
 (strict-SEQ stencil events and in-dispatch repeats), ``CEP_BENCH_EXTRAS``
 / ``CEP_BENCH_BUDGET_S`` / ``CEP_BENCH_{KLEENE,BANK,SHARD}_*`` (configs
-2-4), ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
+2-4), ``CEP_BENCH_HOT_ENTRIES`` (two-tier hot-window headline rerun,
+default 16, 0 skips), ``CEP_PLATFORM`` (force a JAX platform, e.g.
+``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -406,7 +408,64 @@ def bench_engine(K, T, reps):
                 del bb, bs0, bstate, bout
             except Exception as e:  # never break the headline
                 log(f"recall-curve point failed: {type(e).__name__}: {e}")
-    return K * T / best, spread, counters, recall, precision
+
+    # Two-tier hot-window headline (ISSUE 1): the same trace and shapes
+    # with slab_hot_entries = CEP_BENCH_HOT_ENTRIES (default 16, 0 skips).
+    # Matches are bit-identical by construction (parity suites); reported
+    # here are the speed delta and the residency telemetry that explains
+    # it (hot-hit rate = the fraction of walk hops that paid an E_hot-sized
+    # reduce instead of an E-sized one).
+    hot_n = int(os.environ.get("CEP_BENCH_HOT_ENTRIES", "16"))
+    hot_metrics = None
+    if hot_n > 0 and hot_n % 8 == 0 and hot_n < cfg.slab_entries:
+        try:
+            import dataclasses
+
+            hcfg = dataclasses.replace(cfg, slab_hot_entries=hot_n)
+            hb = BatchMatcher(stock_demo.stock_pattern(), K, hcfg)
+            hs0 = hb.init_state()
+            hstate, hout = hb.scan(hs0, events)
+            jax.block_until_ready(hout.count)
+            hbest = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                hstate, hout = hb.scan(hs0, events)
+                jax.block_until_ready(hout.count)
+                hbest = min(hbest, time.perf_counter() - t0)
+            hcounters = hb.counters(hstate)
+            hhot = hb.hot_counters(hstate)
+            hops = hhot["slab_hot_hits"] + hhot["slab_hot_misses"]
+            hit_rate = hhot["slab_hot_hits"] / hops if hops else 1.0
+            hmatches = int(jnp.sum(hout.count > 0))
+            hot_evps = K * T / hbest
+            log(
+                f"engine[hot E_hot={hot_n}]: {hbest * 1e3:.1f} ms "
+                f"({hot_evps / 1e6:.2f}M ev/s, {hot_evps / (K * T / best):.2f}x "
+                f"single-tier), hot-hit rate {hit_rate:.3f}, "
+                f"{hmatches} match slots (single-tier: {matches}), "
+                f"hot counters {hhot}"
+            )
+            if hcounters != counters:
+                log(
+                    "engine[hot]: WARNING drop counters diverged from "
+                    f"single-tier: {hcounters} vs {counters}"
+                )
+            hot_metrics = {
+                "hot_entries": hot_n,
+                "evps": round(hot_evps, 1),
+                "speedup_vs_single_tier": round(hot_evps / (K * T / best), 3),
+                "hot_hit_rate": round(hit_rate, 4),
+                "match_slots": hmatches,
+                "match_slots_single_tier": matches,
+                "hot_counters": hhot,
+                "counters_match_single_tier": hcounters == counters,
+            }
+            del hb, hs0, hstate, hout
+        except Exception as e:  # never break the headline
+            log(f"hot-tier bench failed: {type(e).__name__}: {e}")
+    else:
+        log(f"engine[hot]: skipped (CEP_BENCH_HOT_ENTRIES={hot_n})")
+    return K * T / best, spread, counters, recall, precision, hot_metrics
 
 
 def bench_stencil(total_events, reps):
@@ -797,9 +856,8 @@ def main():
 
     parity_gate()
     bench_stencil(int(os.environ.get("CEP_BENCH_STENCIL_N", "1048576")), reps)
-    engine_evps, engine_spread, engine_counters, recall, precision = (
-        bench_engine(K, T, reps)
-    )
+    (engine_evps, engine_spread, engine_counters, recall, precision,
+     hot_metrics) = bench_engine(K, T, reps)
     if os.environ.get("CEP_BENCH_LOSSFREE", "1") != "0":
         lf_evps, lf_zero, lf_parity = bench_lossfree(
             int(os.environ.get("CEP_BENCH_LOSSFREE_K", "1024")),
@@ -912,6 +970,9 @@ def main():
                     round(precision, 4) if precision is not None else None
                 ),
                 "counters": engine_counters,
+                # Two-tier hot-window run on the same trace/shapes (None
+                # when CEP_BENCH_HOT_ENTRIES=0 or the run failed).
+                "hot_tier": hot_metrics,
                 "lossfree_evps": round(lf_evps, 1),
                 "lossfree_counters_zero": bool(lf_zero),
                 "lossfree_oracle_parity": bool(lf_parity),

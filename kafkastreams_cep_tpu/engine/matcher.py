@@ -115,6 +115,20 @@ class EngineConfig:
 
     max_runs: int = 16  # R — run-queue slots (overflow counted in run_drops)
     slab_entries: int = 64  # E — shared-buffer slots per key
+    # E_hot — hot-tier slots of the two-tier slab layout (0 = legacy single
+    # tier).  Slots [0, E_hot) hold the most recent entries (new entries
+    # always allocate hot; the least-recent hot entry demotes to the
+    # overflow tier when the hot tier fills), and the walk passes resolve
+    # each hop against the hot rows first, touching the overflow rows only
+    # on a miss — in the Pallas kernels the common hop pays an E_hot-sized
+    # reduce instead of an E-sized one (PROFILE_r05.md finding 2).  Capacity
+    # semantics are unchanged: every drop counter is bit-identical to the
+    # single-tier engine, and matches/slab contents agree modulo which slot
+    # (tier) an entry occupies.  Must be a multiple of 8 (TPU sublane tile)
+    # strictly below slab_entries.  Residency telemetry rides the
+    # slab_hot_hits / slab_hot_misses / slab_overflow_walks /
+    # slab_demotions counters (HOT_COUNTER_NAMES).
+    slab_hot_entries: int = 0
     slab_preds: int = 8  # MP — predecessor pointers per buffer entry
     dewey_depth: int = 12  # D — fixed Dewey width (overflow counted)
     max_walk: int = 16  # W — buffer walk bound = max match length
@@ -233,6 +247,19 @@ COUNTER_NAMES = (
     "walk_collisions",
 )
 
+# Two-tier residency telemetry (EngineConfig.slab_hot_entries) — kept OUT of
+# COUNTER_NAMES on purpose: those are overflow/drop counters whose all-zero
+# state means "loss-free" (bench.py, sizing.py rely on that), while these
+# only describe where walk hops resolved and are nonzero on any two-tier
+# run.  Same single-source discipline: every reporter derives from this
+# pair.
+HOT_COUNTER_NAMES = (
+    "slab_hot_hits",
+    "slab_hot_misses",
+    "slab_overflow_walks",
+    "slab_demotions",
+)
+
 
 def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
     """The counters of ``state`` in ``COUNTER_NAMES`` order."""
@@ -244,6 +271,16 @@ def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
         state.slab.missing,
         state.slab.trunc,
         state.slab.collisions,
+    )
+
+
+def hot_counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
+    """The two-tier counters of ``state`` in ``HOT_COUNTER_NAMES`` order."""
+    return (
+        state.slab.hot_hits,
+        state.slab.hot_misses,
+        state.slab.overflow_walks,
+        state.slab.demotions,
     )
 
 
@@ -259,6 +296,7 @@ class StepPhases(NamedTuple):
     out_base: int
     out_rows: int
     max_walk: int
+    hot_entries: int
 
 
 class _ChainRecord(NamedTuple):
@@ -315,6 +353,14 @@ def _build_step(tables, cfg: EngineConfig):
             "fall back to one matcher per query otherwise"
         )
     R, D, W = cfg.max_runs, cfg.dewey_depth, cfg.max_walk
+    EH = cfg.slab_hot_entries
+    if EH:
+        if EH % 8 or not 0 < EH < cfg.slab_entries:
+            raise ValueError(
+                f"slab_hot_entries={EH} must be a multiple of 8 strictly "
+                f"below slab_entries={cfg.slab_entries} (0 disables the "
+                "two-tier layout)"
+            )
     H = tables.max_hops
     NS = max(max(t.num_states for t in tlist), 1)
     S_CAND = 1 + H + 1  # survivor, branch per hop, re-seed
@@ -708,11 +754,11 @@ def _build_step(tables, cfg: EngineConfig):
                 chained = en & (put_prev[h] >= 0)
                 slab = slab_mod.put_first(
                     slab, put_cur[h], off,
-                    put_ver[h], put_vlen[h], enable=first,
+                    put_ver[h], put_vlen[h], enable=first, hot_entries=EH,
                 )
                 slab = slab_mod.put(
                     slab, put_cur[h], off, put_prev[h], prev_off,
-                    put_ver[h], put_vlen[h], enable=chained,
+                    put_ver[h], put_vlen[h], enable=chained, hot_entries=EH,
                 )
             br_en = get_at(rec.br_en, r)
             br_prev = get_at(rec.br_prev, r)
@@ -722,13 +768,13 @@ def _build_step(tables, cfg: EngineConfig):
                 slab = slab_mod.branch(
                     slab, br_prev[h], prev_off,
                     br_ver[h], br_vlen[h], W,
-                    enable=br_en[h],
+                    enable=br_en[h], hot_entries=EH,
                 )
             dead_en = get_at(rec.dead, r) & (prev_off >= 0)
             slab, _, _, _ = slab_mod.peek(
                 slab, jnp.maximum(get_at(state.id_pos, r), 0), prev_off,
                 get_at(state.ver, r), get_at(state.vlen, r), W,
-                remove=True, enable=dead_en,
+                remove=True, enable=dead_en, hot_entries=EH,
             )
             return slab
 
@@ -738,6 +784,7 @@ def _build_step(tables, cfg: EngineConfig):
             slab, st_row, off_row, cnt = slab_mod.peek(
                 slab, get_at(rec.surv_id, r), off, get_at(rec.surv_ver, r),
                 get_at(rec.surv_vlen, r), W, remove=True, enable=fe,
+                hot_entries=EH,
             )
             out_stage = put_at(out_stage, r, st_row[None, :], enable=fe)
             out_off = put_at(out_off, r, off_row[None, :], enable=fe)
@@ -770,12 +817,13 @@ def _build_step(tables, cfg: EngineConfig):
             # masks fuse well under XLA; the fused kernel path applies
             # puts in-kernel instead.)
             slab = slab_mod.puts_batched(
-                state.slab, build_puts(state, rec, ev), off
+                state.slab, build_puts(state, rec, ev), off, hot_entries=EH
             )
             wk = build_walkers(state, rec, ev)
             slab, out_stage, out_off, out_count = slab_mod.walks_compacted(
                 slab, *wk, W,
                 budget=cfg.walker_budget, out_base=RH + R, out_rows=R,
+                hot_entries=EH,
             )
 
         return finish(state, ev, rec, slab, out_stage, out_off, out_count,
@@ -918,6 +966,7 @@ def _build_step(tables, cfg: EngineConfig):
         out_base=RH + R,
         out_rows=R,
         max_walk=W,
+        hot_entries=EH,
     )
     return step, init_state, phases
 
@@ -968,6 +1017,15 @@ class TPUMatcher:
         """Host-side diagnostic snapshot of all overflow/drop counters."""
         return {
             n: int(v) for n, v in zip(COUNTER_NAMES, counter_values(state))
+        }
+
+    def hot_counters(self, state: EngineState) -> Dict[str, int]:
+        """Two-tier residency telemetry (all zero when
+        ``slab_hot_entries == 0``) — reported separately from
+        :meth:`counters` because these are not loss indicators."""
+        return {
+            n: int(v)
+            for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
         }
 
 

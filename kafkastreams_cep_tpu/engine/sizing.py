@@ -136,13 +136,15 @@ def suggest(tables, report: ProbeReport, margin: float = 1.5) -> EngineConfig:
     S = tables.num_stages
     floor_runs = S + 2
     cfg = report.config
+    slab_entries = _round8(max(8, int(report.max_live_entries * margin)))
     return dataclasses.replace(
         cfg,
         max_runs=_round8(
             max(floor_runs, int(report.max_alive_runs * margin))
         ),
-        slab_entries=_round8(
-            max(8, int(report.max_live_entries * margin))
+        slab_entries=slab_entries,
+        slab_hot_entries=suggest_hot_entries(
+            slab_entries, report.max_alive_runs
         ),
         slab_preds=_round8(max(2, int(report.max_npreds * margin))),
         dewey_depth=_round8(
@@ -152,6 +154,23 @@ def suggest(tables, report: ProbeReport, margin: float = 1.5) -> EngineConfig:
             tables.max_hops + 2, int(report.max_match_len * margin) + 2
         ),
     )
+
+
+def suggest_hot_entries(slab_entries: int, max_alive_runs: int) -> int:
+    """E_hot for a derived ``slab_entries``.
+
+    The hot tier is a perf knob, not a capacity knob (drops are identical
+    at any E_hot — ops/slab.py "Two-tier layout"), so sizing targets the
+    walk access pattern: walks start at run pointer events and the current
+    event, so the per-step *fresh* working set is bounded by the live run
+    count, and PROFILE_r05's E-sweep puts the sweet spot for the hot
+    window at ~16-24 rows.  Below E=32 a two-tier split buys nothing (the
+    full reduce is already hot-sized) and 0 keeps the legacy single tier.
+    """
+    if slab_entries < 32:
+        return 0
+    e_hot = _round8(max(8, min(24, 2 * max_alive_runs)))
+    return min(e_hot, slab_entries - 8)
 
 
 def capacity_counters(counters: Dict[str, int]) -> Dict[str, int]:

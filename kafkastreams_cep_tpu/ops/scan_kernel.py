@@ -56,6 +56,12 @@ logger = get_logger("ops.scan_kernel")
 
 LANE_BLOCK = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams across the versions this
+# engine runs on (laptop CI pins an older jaxlib than the TPU hosts).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def _cumsum0(x):
     """Inclusive prefix sum along axis 0 via log-shift adds — Mosaic has
@@ -91,6 +97,15 @@ def build_scan(tables, config: EngineConfig):
         cfg.max_runs, cfg.slab_entries, cfg.slab_preds, cfg.dewey_depth,
         cfg.max_walk,
     )
+    # Two-tier slab layout (ops/slab.py "Two-tier layout" note): rows
+    # [0, EHk) hot, [EHk, E) overflow.  slab_hot_entries == 0 instantiates
+    # the legacy single tier as EHk = E / EO = 0 — the overflow-side blocks
+    # below then vanish at trace time and the hot-side code is the original
+    # full-slab code.
+    EH = cfg.slab_hot_entries
+    EHk = EH if EH else E
+    EO = E - EHk
+    N_OUT = 30  # kernel output refs (run state + slab + counters + emits)
     H = tables.max_hops
     NS = max(tables.num_states, 1)
     S_CAND = 1 + H + 1
@@ -148,7 +163,7 @@ def build_scan(tables, config: EngineConfig):
         # slab
         sstage, soff, srefs, snpreds, spstage, spoff, spvlen, spver,
         # counters
-        run_drops, ver_ovf, fulld, predd, missing, trunc,
+        run_drops, ver_ovf, fulld, predd, missing, trunc, hh, hm, ow, dm,
         # per-t event slices
         ev_key, ev_ts, ev_off, ev_valid, *rest,
     ):
@@ -157,7 +172,12 @@ def build_scan(tables, config: EngineConfig):
         (o_alive, o_id, o_eval, o_vlen, o_event, o_start, o_branch, o_agg,
          o_ver, o_sstage, o_soff, o_srefs, o_snpreds, o_spstage, o_spoff,
          o_spvlen, o_spver, o_rd, o_vo, o_fd, o_pd, o_ms, o_tr,
-         o_ostage, o_ooff, o_ocount) = rest[n_leaves:]
+         o_hh, o_hm, o_ow, o_dm,
+         o_ostage, o_ooff, o_ocount) = rest[n_leaves:n_leaves + N_OUT]
+        if EO:
+            (sc_found, sc_refs, sc_np, sc_ps, sc_po, sc_pl, sc_pv) = rest[
+                n_leaves + N_OUT:
+            ]
 
         t = pl.program_id(1)
 
@@ -186,6 +206,10 @@ def build_scan(tables, config: EngineConfig):
             o_pd[:] = predd[:]
             o_ms[:] = missing[:]
             o_tr[:] = trunc[:]
+            o_hh[:] = hh[:]
+            o_hm[:] = hm[:]
+            o_ow[:] = ow[:]
+            o_dm[:] = dm[:]
 
         # Event blocks arrive [1, 1, L] ([T, 1, K] arrays — the middle 1
         # keeps the trailing dims tileable); squeeze the t axis.
@@ -428,6 +452,11 @@ def build_scan(tables, config: EngineConfig):
         iota_mp = jax.lax.broadcasted_iota(i32, (MP, L), 0)
         iota_mp3 = jax.lax.broadcasted_iota(i32, (E, MP, L), 1)
         iota_d3 = jax.lax.broadcasted_iota(i32, (D, MP, L), 0)
+        iota_eh = jax.lax.broadcasted_iota(i32, (EHk, L), 0)
+        iota_mp3h = jax.lax.broadcasted_iota(i32, (EHk, MP, L), 1)
+        if EO:
+            iota_eo = jax.lax.broadcasted_iota(i32, (EO, L), 0)
+            iota_mp3o = jax.lax.broadcasted_iota(i32, (EO, MP, L), 1)
 
         def put_body(b):
             pselm = p_rank == b  # [RH, L]
@@ -454,9 +483,85 @@ def build_scan(tables, config: EngineConfig):
             cur_hit = (o_sstage[:] == cur_s) & (o_soff[:] == off_l)
             exist = jnp.any(cur_hit, axis=0, keepdims=True)
             free = o_sstage[:] < 0
-            ffs = jnp.min(jnp.where(free, iota_e, E), axis=0, keepdims=True)
-            has_free = ffs < E
-            tgt = (exist & cur_hit) | (~exist & (iota_e == ffs))
+            # Two-tier allocation (ops/walk_kernel.py put phase): new
+            # entries land hot; hot-full demotes the min-off hot entry to
+            # a free overflow slot; drops only when the whole slab is full.
+            free_h = free[0:EHk]
+            ffs_h = jnp.min(
+                jnp.where(free_h, iota_eh, EHk), axis=0, keepdims=True
+            )
+            any_fh = ffs_h < EHk
+            if EO:
+                free_o = free[EHk:]
+                ffs_o = jnp.min(
+                    jnp.where(free_o, iota_eo, EO), axis=0, keepdims=True
+                )
+                any_fo = ffs_o < EO
+                okey = jnp.where(
+                    ~free_h, o_soff[0:EHk], jnp.int32(1 << 30)
+                )
+                vkey = jnp.min(okey, axis=0, keepdims=True)
+                vslot = jnp.min(
+                    jnp.where(okey == vkey, iota_eh, EHk),
+                    axis=0, keepdims=True,
+                )
+                demote = en_ok & ~exist & ~any_fh & any_fo
+                o_dm[:] = o_dm[:] + jnp.where(demote, 1, 0)
+
+                @pl.when(jnp.any(demote))
+                def _():
+                    vm = (iota_eh == vslot) & demote  # [EHk, L]
+                    om = (iota_eo == ffs_o) & demote  # [EO, L]
+
+                    def mv2(ref):
+                        v = jnp.sum(
+                            jnp.where(vm, ref[0:EHk], 0),
+                            axis=0, keepdims=True,
+                        )
+                        ref[EHk:] = jnp.where(om, v, ref[EHk:])
+
+                    mv2(o_srefs)
+                    mv2(o_snpreds)
+
+                    def mv3(ref):
+                        v = jnp.sum(
+                            jnp.where(vm[:, None, :], ref[0:EHk], 0), axis=0
+                        )  # [MP, L]
+                        ref[EHk:] = jnp.where(
+                            om[:, None, :], v[None], ref[EHk:]
+                        )
+
+                    mv3(o_spstage)
+                    mv3(o_spoff)
+                    mv3(o_spvlen)
+                    v4 = jnp.sum(
+                        jnp.where(
+                            vm[None, :, None, :], o_spver[:, 0:EHk], 0
+                        ),
+                        axis=1,
+                    )  # [D, MP, L]
+                    o_spver[:, EHk:] = jnp.where(
+                        om[None, :, None, :], v4[:, None], o_spver[:, EHk:]
+                    )
+                    vstage = jnp.sum(
+                        jnp.where(vm, o_sstage[0:EHk], 0),
+                        axis=0, keepdims=True,
+                    )
+                    voff = jnp.sum(
+                        jnp.where(vm, o_soff[0:EHk], 0),
+                        axis=0, keepdims=True,
+                    )
+                    o_sstage[EHk:] = jnp.where(om, vstage, o_sstage[EHk:])
+                    o_soff[EHk:] = jnp.where(om, voff, o_soff[EHk:])
+                    o_sstage[0:EHk] = jnp.where(vm, -1, o_sstage[0:EHk])
+                    o_soff[0:EHk] = jnp.where(vm, -1, o_soff[0:EHk])
+
+                alloc = jnp.where(any_fh, ffs_h, vslot)
+                has_free = any_fh | any_fo
+            else:
+                alloc = ffs_h
+                has_free = any_fh
+            tgt = (exist & cur_hit) | (~exist & (iota_e == alloc))
             ok = en_ok & (exist | has_free)
             o_fd[:] = o_fd[:] + jnp.where(en_ok & ~exist & ~has_free, 1, 0)
             m1 = tgt & ok
@@ -566,26 +671,86 @@ def build_scan(tables, config: EngineConfig):
             def hop_body(c):
                 h, active_i, cs, co, qv, ql, cnt, st_stage, st_off = c
                 hactive = active_i != 0
-                hit = (o_sstage[:] == cs) & (o_soff[:] == co)
-                found = jnp.any(hit, axis=0, keepdims=True)
+                # Hot-tier lookup first (ops/walk_kernel.py hop): the
+                # overflow rows are touched only when some lane of the
+                # block missed hot.
+                hit_h = (o_sstage[0:EHk] == cs) & (o_soff[0:EHk] == co)
+                found_h = jnp.any(hit_h, axis=0, keepdims=True)
+                if EO:
+                    miss = hactive & ~found_h
+                    sc_found[:] = jnp.zeros((1, L), i32)
+                    sc_refs[:] = jnp.zeros((1, L), i32)
+                    sc_np[:] = jnp.zeros((1, L), i32)
+                    sc_ps[:] = jnp.zeros((MP, L), i32)
+                    sc_po[:] = jnp.zeros((MP, L), i32)
+                    sc_pl[:] = jnp.zeros((MP, L), i32)
+                    sc_pv[:] = jnp.zeros((D, MP, L), i32)
+
+                    @pl.when(jnp.any(miss))
+                    def _():
+                        hit_o = (o_sstage[EHk:] == cs) & (
+                            o_soff[EHk:] == co
+                        )
+                        hamo = hit_o & miss  # [EO, L]
+                        sc_found[:] = jnp.where(
+                            jnp.any(hamo, axis=0, keepdims=True), 1, 0
+                        )
+                        sc_refs[:] = jnp.sum(
+                            jnp.where(hamo, o_srefs[EHk:], 0),
+                            axis=0, keepdims=True,
+                        )
+                        sc_np[:] = jnp.sum(
+                            jnp.where(hamo, o_snpreds[EHk:], 0),
+                            axis=0, keepdims=True,
+                        )
+                        hamo3 = hamo[:, None, :]
+                        sc_ps[:] = jnp.sum(
+                            jnp.where(hamo3, o_spstage[EHk:], 0), axis=0
+                        )
+                        sc_po[:] = jnp.sum(
+                            jnp.where(hamo3, o_spoff[EHk:], 0), axis=0
+                        )
+                        sc_pl[:] = jnp.sum(
+                            jnp.where(hamo3, o_spvlen[EHk:], 0), axis=0
+                        )
+                        sc_pv[:] = jnp.sum(
+                            jnp.where(
+                                hamo[None, :, None, :], o_spver[:, EHk:], 0
+                            ),
+                            axis=1,
+                        )
+
+                    act_o = sc_found[:] != 0
+                    found = found_h | act_o
+                    o_hh[:] = o_hh[:] + jnp.where(hactive & found_h, 1, 0)
+                    o_hm[:] = o_hm[:] + jnp.where(miss, 1, 0)
+                    o_ow[:] = o_ow[:] + jnp.where(act_o, 1, 0)
+                else:
+                    act_o = jnp.zeros((1, L), jnp.bool_)
+                    found = found_h
                 o_ms[:] = o_ms[:] + jnp.where(hactive & ~found, 1, 0)
                 hactive = hactive & found
-                ham = hit & hactive
+                ham_h = hit_h & hactive
 
                 refs_e = jnp.sum(
-                    jnp.where(ham, o_srefs[:], 0), axis=0, keepdims=True
+                    jnp.where(ham_h, o_srefs[0:EHk], 0),
+                    axis=0, keepdims=True,
                 )
+                np_e = jnp.sum(
+                    jnp.where(ham_h, o_snpreds[0:EHk], 0),
+                    axis=0, keepdims=True,
+                )
+                if EO:
+                    refs_e = refs_e + sc_refs[:]
+                    np_e = np_e + sc_np[:]
                 newref = jnp.where(
                     wrm_i != 0, jnp.maximum(refs_e - 1, 0), refs_e + 1
                 )
-                o_srefs[:] = jnp.where(ham, newref, o_srefs[:])
-                np_e = jnp.sum(
-                    jnp.where(ham, o_snpreds[:], 0), axis=0, keepdims=True
-                )
+                o_srefs[0:EHk] = jnp.where(ham_h, newref, o_srefs[0:EHk])
                 dele = hactive & (wrm_i != 0) & (newref == 0) & (np_e <= 1)
-                dmask = ham & dele
-                o_sstage[:] = jnp.where(dmask, -1, o_sstage[:])
-                o_soff[:] = jnp.where(dmask, -1, o_soff[:])
+                dmask = ham_h & dele
+                o_sstage[0:EHk] = jnp.where(dmask, -1, o_sstage[0:EHk])
+                o_soff[0:EHk] = jnp.where(dmask, -1, o_soff[0:EHk])
 
                 emit = hactive & (wot_i != 0)
                 mw = (iota_w2 == cnt) & emit
@@ -593,13 +758,19 @@ def build_scan(tables, config: EngineConfig):
                 st_off = jnp.where(mw, co, st_off)
                 cnt = cnt + jnp.where(emit, 1, 0)
 
-                ham3 = ham[:, None, :]
-                ps_ = jnp.sum(jnp.where(ham3, o_spstage[:], 0), axis=0)
-                po_ = jnp.sum(jnp.where(ham3, o_spoff[:], 0), axis=0)
-                pl_ = jnp.sum(jnp.where(ham3, o_spvlen[:], 0), axis=0)
+                ham3 = ham_h[:, None, :]
+                ps_ = jnp.sum(jnp.where(ham3, o_spstage[0:EHk], 0), axis=0)
+                po_ = jnp.sum(jnp.where(ham3, o_spoff[0:EHk], 0), axis=0)
+                pl_ = jnp.sum(jnp.where(ham3, o_spvlen[0:EHk], 0), axis=0)
                 pv_ = jnp.sum(
-                    jnp.where(ham[None, :, None, :], o_spver[:], 0), axis=1
+                    jnp.where(ham_h[None, :, None, :], o_spver[:, 0:EHk], 0),
+                    axis=1,
                 )  # [D, MP, L]
+                if EO:
+                    ps_ = ps_ + sc_ps[:]
+                    po_ = po_ + sc_po[:]
+                    pl_ = pl_ + sc_pl[:]
+                    pv_ = pv_ + sc_pv[:]
                 live = iota_mp < np_e
 
                 neq = (qv[:, None, :] != pv_).astype(i32)
@@ -628,29 +799,62 @@ def build_scan(tables, config: EngineConfig):
                 ohj = iota_mp == j
 
                 prune = selany & hactive & (wrm_i != 0) & (newref == 0)
+                prune_h = prune & found_h
 
-                @pl.when(jnp.any(prune))
-                def _():
-                    pm = ham3 & (iota_mp3 >= j[None]) & prune[None]
-
-                    def shift(ref, m, axis=1):
-                        f = ref[:]
-                        nxt = jnp.concatenate(
-                            [
-                                jax.lax.slice_in_dim(f, 1, None, axis=axis),
-                                jax.lax.slice_in_dim(f, -1, None, axis=axis),
-                            ],
-                            axis=axis,
-                        )
-                        ref[:] = jnp.where(m, nxt, f)
-
-                    shift(o_spstage, pm)
-                    shift(o_spoff, pm)
-                    shift(o_spvlen, pm)
-                    shift(o_spver, pm[None], axis=2)
-                    o_snpreds[:] = o_snpreds[:] - jnp.where(
-                        ham & prune, 1, 0
+                def _shifted(f, m, axis):
+                    nxt = jnp.concatenate(
+                        [
+                            jax.lax.slice_in_dim(f, 1, None, axis=axis),
+                            jax.lax.slice_in_dim(f, -1, None, axis=axis),
+                        ],
+                        axis=axis,
                     )
+                    return jnp.where(m, nxt, f)
+
+                @pl.when(jnp.any(prune_h))
+                def _():
+                    pm = ham3 & (iota_mp3h >= j[None]) & prune_h[None]
+                    o_spstage[0:EHk] = _shifted(o_spstage[0:EHk], pm, 1)
+                    o_spoff[0:EHk] = _shifted(o_spoff[0:EHk], pm, 1)
+                    o_spvlen[0:EHk] = _shifted(o_spvlen[0:EHk], pm, 1)
+                    o_spver[:, 0:EHk] = _shifted(
+                        o_spver[:, 0:EHk], pm[None], 2
+                    )
+                    o_snpreds[0:EHk] = o_snpreds[0:EHk] - jnp.where(
+                        ham_h & prune_h, 1, 0
+                    )
+
+                if EO:
+                    # One overflow-side mutation pass: refs decrement,
+                    # delete, and prune for walkers resolved overflow —
+                    # skipped whenever every lane resolved hot.
+                    @pl.when(jnp.any(act_o))
+                    def _():
+                        hit_o = (o_sstage[EHk:] == cs) & (
+                            o_soff[EHk:] == co
+                        )
+                        hamo = hit_o & act_o
+                        o_srefs[EHk:] = jnp.where(
+                            hamo, newref, o_srefs[EHk:]
+                        )
+                        dmo = hamo & dele
+                        o_sstage[EHk:] = jnp.where(dmo, -1, o_sstage[EHk:])
+                        o_soff[EHk:] = jnp.where(dmo, -1, o_soff[EHk:])
+                        prune_o = prune & act_o
+                        pmo = (
+                            hamo[:, None, :]
+                            & (iota_mp3o >= j[None])
+                            & prune_o[None]
+                        )
+                        o_spstage[EHk:] = _shifted(o_spstage[EHk:], pmo, 1)
+                        o_spoff[EHk:] = _shifted(o_spoff[EHk:], pmo, 1)
+                        o_spvlen[EHk:] = _shifted(o_spvlen[EHk:], pmo, 1)
+                        o_spver[:, EHk:] = _shifted(
+                            o_spver[:, EHk:], pmo[None], 2
+                        )
+                        o_snpreds[EHk:] = o_snpreds[EHk:] - jnp.where(
+                            hamo & prune_o, 1, 0
+                        )
 
                 nxt_s = jnp.sum(jnp.where(ohj, ps_, 0), axis=0, keepdims=True)
                 nxt_o = jnp.sum(jnp.where(ohj, po_, 0), axis=0, keepdims=True)
@@ -861,6 +1065,10 @@ def build_scan(tables, config: EngineConfig):
             row(state.slab.pred_drops),
             row(state.slab.missing),
             row(state.slab.trunc),
+            row(state.slab.hot_hits),
+            row(state.slab.hot_misses),
+            row(state.slab.overflow_walks),
+            row(state.slab.demotions),
             tev(jnp.asarray(events.key, jnp.int32)),
             tev(jnp.asarray(events.ts, jnp.int32)),
             tev(jnp.asarray(events.off, jnp.int32)),
@@ -894,7 +1102,7 @@ def build_scan(tables, config: EngineConfig):
                 memory_space=pltpu.VMEM,
             )
 
-        n_state = 23
+        n_state = 27
         in_specs = (
             [state_spec(tuple(x.shape)) for x in ins[:n_state]]
             + [ev_spec(tuple(x.shape)) for x in ins[n_state:]]
@@ -928,14 +1136,31 @@ def build_scan(tables, config: EngineConfig):
             jax.ShapeDtypeStruct((1, K), i32),  # pred_drops
             jax.ShapeDtypeStruct((1, K), i32),  # missing
             jax.ShapeDtypeStruct((1, K), i32),  # trunc
+            jax.ShapeDtypeStruct((1, K), i32),  # hot_hits
+            jax.ShapeDtypeStruct((1, K), i32),  # hot_misses
+            jax.ShapeDtypeStruct((1, K), i32),  # overflow_walks
+            jax.ShapeDtypeStruct((1, K), i32),  # demotions
             jax.ShapeDtypeStruct((T, R, W, K), i32),  # out stage
             jax.ShapeDtypeStruct((T, R, W, K), i32),  # out off
             jax.ShapeDtypeStruct((T, R, K), i32),  # out count
         ]
         out_specs = (
-            [state_spec(tuple(s.shape)) for s in out_shapes[:23]]
-            + [out_t_spec(tuple(s.shape)) for s in out_shapes[23:]]
+            [state_spec(tuple(s.shape)) for s in out_shapes[:n_state]]
+            + [out_t_spec(tuple(s.shape)) for s in out_shapes[n_state:]]
         )
+        scratch_shapes = []
+        if EO:
+            # Per-hop staging of the overflow tier's contribution (written
+            # only under the miss branch, read in the combine).
+            scratch_shapes = [
+                pltpu.VMEM((1, LANE_BLOCK), jnp.int32),  # sc_found
+                pltpu.VMEM((1, LANE_BLOCK), jnp.int32),  # sc_refs
+                pltpu.VMEM((1, LANE_BLOCK), jnp.int32),  # sc_np
+                pltpu.VMEM((MP, LANE_BLOCK), jnp.int32),  # sc_ps
+                pltpu.VMEM((MP, LANE_BLOCK), jnp.int32),  # sc_po
+                pltpu.VMEM((MP, LANE_BLOCK), jnp.int32),  # sc_pl
+                pltpu.VMEM((D, MP, LANE_BLOCK), jnp.int32),  # sc_pv
+            ]
 
         outs = pl.pallas_call(
             kernel,
@@ -943,16 +1168,18 @@ def build_scan(tables, config: EngineConfig):
             in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shapes,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=110 * 1024 * 1024,
                 dimension_semantics=("parallel", "arbitrary"),
             ),
+            scratch_shapes=scratch_shapes,
             interpret=scan.interpret,
         )(*ins)
 
         (n_alive, n_id, n_eval, n_vlen, n_event, n_start, n_branch, n_agg,
          n_ver, n_sstage, n_soff, n_srefs, n_snpreds, n_spstage, n_spoff,
          n_spvlen, n_spver, n_rd, n_vo, n_fd, n_pd, n_ms, n_tr,
+         n_hh, n_hm, n_ow, n_dm,
          o_stage, o_off, o_count) = outs
 
         unrow = lambda x: x[0]
@@ -980,6 +1207,10 @@ def build_scan(tables, config: EngineConfig):
                 missing=unrow(n_ms),
                 trunc=unrow(n_tr),
                 collisions=state.slab.collisions,  # sequential: none
+                hot_hits=unrow(n_hh),
+                hot_misses=unrow(n_hm),
+                overflow_walks=unrow(n_ow),
+                demotions=unrow(n_dm),
             ),
             run_drops=unrow(n_rd),
             ver_overflows=unrow(n_vo),

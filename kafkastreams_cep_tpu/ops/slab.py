@@ -75,6 +75,13 @@ class SlabState(NamedTuple):
     #   0 on the default paths (walker_budget=1 runs walkers alone; the
     #   Pallas kernel is sequential by construction); nonzero means a
     #   walker_budget>1 run may have diverged (see EngineConfig).
+    # --- two-tier telemetry (zero when hot_entries == 0; see module note
+    #     "Two-tier layout" below).  Not capacity counters: they never
+    #     indicate loss, only where walk hops resolved.
+    hot_hits: jnp.ndarray  # scalar int32 — walk hops resolved in the hot tier
+    hot_misses: jnp.ndarray  # scalar int32 — walk hops not resolved hot
+    overflow_walks: jnp.ndarray  # scalar int32 — walk hops resolved overflow
+    demotions: jnp.ndarray  # scalar int32 — hot -> overflow entry moves
 
 
 def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
@@ -94,6 +101,10 @@ def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
         missing=jnp.zeros((), dtype=i32),
         trunc=jnp.zeros((), dtype=i32),
         collisions=jnp.zeros((), dtype=i32),
+        hot_hits=jnp.zeros((), dtype=i32),
+        hot_misses=jnp.zeros((), dtype=i32),
+        overflow_walks=jnp.zeros((), dtype=i32),
+        demotions=jnp.zeros((), dtype=i32),
     )
 
 
@@ -106,6 +117,99 @@ def find(slab: SlabState, stage, off) -> Tuple[jnp.ndarray, jnp.ndarray]:
 def _alloc(slab: SlabState):
     free = slab.stage < 0
     return jnp.argmax(free), jnp.any(free)
+
+
+# ---------------------------------------------------------------------------
+# Two-tier layout (``hot_entries`` static knob, 0 = legacy single tier)
+#
+# Slots ``[0, hot_entries)`` are the *hot tier*, the rest the *overflow
+# tier*.  New entries always land in the hot tier: a free hot slot if one
+# exists, else the least-recent hot entry (minimum event offset — offsets
+# are monotone per lane, so the offset IS the recency; ties break to the
+# lowest index) is *demoted* into a free overflow slot and its hot slot
+# reused.  An allocation fails only when the WHOLE slab is full — exactly
+# the single-tier drop condition — so ``full_drops`` and every other
+# overflow counter stay bit-identical to the single-tier engine; only the
+# slot an entry occupies (its tier placement) may differ.
+#
+# Lookups key on ``(stage, off)``, which is unique across the whole slab,
+# so results are placement-independent; this jnp path therefore keeps its
+# full-slab masked lookups (under XLA both tiers would be computed anyway)
+# and only *accounts* tier residency via the hot_hits / hot_misses /
+# overflow_walks counters.  The Pallas kernels (``ops/walk_kernel.py``,
+# ``ops/scan_kernel.py``) exploit the same layout structurally: the per-hop
+# reduce runs over the hot rows only and the overflow rows are touched
+# under a block-level ``pl.when`` that skips entirely when every lane of
+# the block resolved hot — the E-linear hop cost drops to E_hot-linear on
+# the common path (PROFILE_r05.md finding 2, redesign candidate 1).
+# ---------------------------------------------------------------------------
+
+
+def _alloc_slot(slab: SlabState, hot_entries: int, want):
+    """Allocation slot for one new entry, two-tier aware.
+
+    Returns ``(slab, e, ok)``.  ``want`` gates the (slab-mutating)
+    demotion: pass ``enable & ~found`` so lookups that reuse an existing
+    entry never demote.  With ``hot_entries == 0`` this is :func:`_alloc`.
+    """
+    free = slab.stage < 0
+    if not hot_entries:
+        return slab, jnp.argmax(free), jnp.any(free)
+    E = slab.stage.shape[0]
+    EH = hot_entries
+    i32 = jnp.int32
+    idx = jnp.arange(E, dtype=i32)
+    is_hot = idx < EH
+    free_hot = free & is_hot
+    free_ov = free & ~is_hot
+    any_fh = jnp.any(free_hot)
+    any_fo = jnp.any(free_ov)
+    e_hot = jnp.argmax(free_hot).astype(i32)
+    e_ov = jnp.argmax(free_ov).astype(i32)
+    # Demotion victim: least-recent (min event offset) occupied hot entry,
+    # first index on ties — deterministic, matched by both Pallas kernels.
+    occ_hot = ~free & is_hot
+    okey = jnp.where(occ_hot, slab.off, i32(1 << 30))
+    victim = jnp.argmin(okey).astype(i32)
+    demote = jnp.asarray(want) & ~any_fh & any_fo
+
+    vm = _oh(victim, E) & demote
+    om = _oh(e_ov, E) & demote
+
+    def mv(field):
+        m_v = vm.reshape((E,) + (1,) * (field.ndim - 1))
+        m_o = om.reshape((E,) + (1,) * (field.ndim - 1))
+        row = jnp.sum(jnp.where(m_v, field, 0), axis=0)
+        return jnp.where(m_o, row[None].astype(field.dtype), field)
+
+    slab = slab._replace(
+        stage=jnp.where(vm, -1, mv(slab.stage)),
+        off=jnp.where(vm, -1, mv(slab.off)),
+        refs=mv(slab.refs),
+        npreds=mv(slab.npreds),
+        pstage=mv(slab.pstage),
+        poff=mv(slab.poff),
+        pver=mv(slab.pver),
+        pvlen=mv(slab.pvlen),
+        demotions=slab.demotions + jnp.where(demote, 1, 0),
+    )
+    e = jnp.where(any_fh, e_hot, victim)
+    return slab, e, any_fh | any_fo
+
+
+def _tier_counts(slab: SlabState, active, found_hot, found):
+    """Walk-hop tier accounting: ``active`` walkers whose entry resolved in
+    the hot tier / did not / resolved in the overflow tier.  Works on any
+    matching bool shapes (scalar per-walker or ``[P]`` lockstep)."""
+    i32 = jnp.int32
+    return slab._replace(
+        hot_hits=slab.hot_hits
+        + jnp.sum((active & found_hot).astype(i32)),
+        hot_misses=slab.hot_misses
+        + jnp.sum((active & ~found_hot).astype(i32)),
+        overflow_walks=slab.overflow_walks
+        + jnp.sum((active & ~found_hot & found).astype(i32)),
+    )
 
 
 def _select_pointer(slab: SlabState, e, qver, qlen):
@@ -161,13 +265,15 @@ def _prune_pointer(slab: SlabState, e, j, enable):
     )
 
 
-def put_first(slab: SlabState, stage, off, ver, vlen, enable=True) -> SlabState:
+def put_first(
+    slab: SlabState, stage, off, ver, vlen, enable=True, hot_entries: int = 0
+) -> SlabState:
     """First-stage put: fresh entry whose single null-predecessor pointer
     records the run version; overwrites any existing entry
     (``KVSharedVersionedBuffer.java:117-128``)."""
     enable = jnp.asarray(enable)
     existing, found = find(slab, stage, off)
-    free, has_free = _alloc(slab)
+    slab, free, has_free = _alloc_slot(slab, hot_entries, enable & ~found)
     e = jnp.where(found, existing, free)
     ok = enable & (found | has_free)
     m1 = _oh(e, slab.stage.shape[0]) & ok
@@ -182,7 +288,7 @@ def put_first(slab: SlabState, stage, off, ver, vlen, enable=True) -> SlabState:
     return _append_pointer(slab, e, jnp.int32(-1), jnp.int32(-1), ver, vlen, ok)
 
 
-def put(slab: SlabState, cur_stage, cur_off, prev_stage, prev_off, ver, vlen, enable=True) -> SlabState:
+def put(slab: SlabState, cur_stage, cur_off, prev_stage, prev_off, ver, vlen, enable=True, hot_entries: int = 0) -> SlabState:
     """Append a versioned predecessor pointer to ``(cur_stage, cur_off)``.
 
     The predecessor entry must exist (``KVSharedVersionedBuffer.java:86-89``);
@@ -194,7 +300,7 @@ def put(slab: SlabState, cur_stage, cur_off, prev_stage, prev_off, ver, vlen, en
     enable = enable & prev_found
 
     existing, found = find(slab, cur_stage, cur_off)
-    free, has_free = _alloc(slab)
+    slab, free, has_free = _alloc_slot(slab, hot_entries, enable & ~found)
     e = jnp.where(found, existing, free)
     create = enable & ~found & has_free
     ok = enable & (found | has_free)
@@ -210,13 +316,17 @@ def put(slab: SlabState, cur_stage, cur_off, prev_stage, prev_off, ver, vlen, en
     return _append_pointer(slab, e, prev_stage, prev_off, ver, vlen, ok)
 
 
-def branch(slab: SlabState, stage, off, ver, vlen, max_walk: int, enable=True) -> SlabState:
+def branch(slab: SlabState, stage, off, ver, vlen, max_walk: int, enable=True, hot_entries: int = 0) -> SlabState:
     """Refcount-increment walk so shared prefixes survive sibling removal
     (``KVSharedVersionedBuffer.java:99-110``)."""
 
     def body(_, carry):
         slab, stage, off, qver, qlen, active = carry
         e, found = find(slab, stage, off)
+        if hot_entries:
+            slab = _tier_counts(
+                slab, active, found & (e < hot_entries), found
+            )
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
         slab = slab._replace(
@@ -255,6 +365,7 @@ def peek(
     max_walk: int,
     remove: bool,
     enable=True,
+    hot_entries: int = 0,
 ):
     """Backward pointer walk assembling a match, final stage first.
 
@@ -273,6 +384,10 @@ def peek(
         slab, stage, off, qver, qlen, active, out_stage, out_off, count = carry
         E = slab.stage.shape[0]
         e, found = find(slab, stage, off)
+        if hot_entries:
+            slab = _tier_counts(
+                slab, active, found & (e < hot_entries), found
+            )
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
         m1 = _oh(e, E) & active
@@ -411,6 +526,7 @@ def walks_batched(
     want_out,
     max_walk: int,
     collect: bool = True,
+    hot_entries: int = 0,
 ):
     """ALL of one step's buffer walks — branch refcount walks, dead-run
     removals, and final-match extractions — in a single lockstep pass.
@@ -463,6 +579,10 @@ def walks_batched(
             slab.off[None, :] == off[:, None]
         )
         found = jnp.any(hit, axis=1)
+        if hot_entries:
+            slab = _tier_counts(
+                slab, active, jnp.any(hit[:, :hot_entries], axis=1), found
+            )
         slab = slab._replace(
             missing=slab.missing + jnp.sum((active & ~found).astype(i32))
         )
@@ -638,7 +758,9 @@ class PutOps(NamedTuple):
     vlen: jnp.ndarray  # [P] int32
 
 
-def puts_batched(slab: SlabState, ops: PutOps, off) -> SlabState:
+def puts_batched(
+    slab: SlabState, ops: PutOps, off, hot_entries: int = 0
+) -> SlabState:
     """Apply all of one step's consuming puts in one pass.
 
     Replicates the sequential semantics op by op: chained puts require an
@@ -649,7 +771,16 @@ def puts_batched(slab: SlabState, ops: PutOps, off) -> SlabState:
     targets share the current event offset ``off``, so groups are keyed by
     ``cur_stage`` alone; predecessors always reference older events, so no
     op's predecessor lookup can observe another op of the same step.
+
+    Two-tier slabs (``hot_entries > 0``) take the ranked sequential loop
+    instead: the closed-form creator-to-free-slot ranking above assumes any
+    free slot is usable, while two-tier allocation interleaves demotions
+    between creations.  The jnp two-tier path exists for differential
+    parity, not throughput (the Pallas kernels are the perf path), so the
+    loop's extra passes are acceptable.
     """
+    if hot_entries:
+        return _puts_sequential(slab, ops, off, hot_entries)
     i32 = jnp.int32
     E, MP = slab.pstage.shape
     P = ops.en.shape[0]
@@ -769,6 +900,32 @@ def puts_batched(slab: SlabState, ops: PutOps, off) -> SlabState:
     )
 
 
+def _puts_sequential(
+    slab: SlabState, ops: PutOps, off, hot_entries: int
+) -> SlabState:
+    """One step's consuming puts applied one op at a time in queue order —
+    the two-tier variant of :func:`puts_batched` (see its docstring)."""
+    from kafkastreams_cep_tpu.ops.onehot import get_at
+
+    P = int(ops.en.shape[0])
+
+    def body(p, slab):
+        en = get_at(ops.en, p)
+        first = get_at(ops.first, p)
+        cur = get_at(ops.cur_stage, p)
+        slab = put_first(
+            slab, cur, off, get_at(ops.ver, p), get_at(ops.vlen, p),
+            enable=en & first, hot_entries=hot_entries,
+        )
+        return put(
+            slab, cur, off, get_at(ops.prev_stage, p),
+            get_at(ops.prev_off, p), get_at(ops.ver, p), get_at(ops.vlen, p),
+            enable=en & ~first, hot_entries=hot_entries,
+        )
+
+    return jax.lax.fori_loop(0, P, body, slab)
+
+
 def _pack_ptrs(slab: SlabState) -> jnp.ndarray:
     """Pointer arrays packed as one f32 tensor ``[E, MP, D+3]`` so walk-hop
     row extraction is a single MXU matmul.  Layout: ver, pstage, poff, pvlen.
@@ -808,7 +965,8 @@ def _compat_rows(qver, qlen, pv, pl):
 
 
 def branch_batched(
-    slab: SlabState, en, stage, off, ver, vlen, max_walk: int
+    slab: SlabState, en, stage, off, ver, vlen, max_walk: int,
+    hot_entries: int = 0,
 ) -> SlabState:
     """All branch refcount walks of one step, in lockstep
     (``KVSharedVersionedBuffer.java:99-110``).
@@ -835,6 +993,10 @@ def branch_batched(
             slab.off[None, :] == off[:, None]
         )
         found = jnp.any(hit, axis=1)
+        if hot_entries:
+            slab = _tier_counts(
+                slab, active, jnp.any(hit[:, :hot_entries], axis=1), found
+            )
         slab = slab._replace(
             missing=slab.missing + jnp.sum((active & ~found).astype(i32))
         )
@@ -896,6 +1058,7 @@ def walks_compacted(
     budget: int,
     out_base: int,
     out_rows: int,
+    hot_entries: int = 0,
 ):
     """The step's walk pass over a *small* compacted walker pool.
 
@@ -969,6 +1132,7 @@ def walks_compacted(
             gather(is_remove),
             gather(want_out),
             W,
+            hot_entries=hot_entries,
         )
         # Scatter served output walkers back to their final-segment rows.
         oho = ohc[out_base:out_base + out_rows]  # [out_rows, B]
@@ -1001,6 +1165,7 @@ def peek_batched(
     vlen,
     max_walk: int,
     remove: bool,
+    hot_entries: int = 0,
 ):
     """Lockstep removal walks — a thin wrapper over :func:`walks_batched`
     with every walker removing and emitting (``remove=False`` keeps the
@@ -1013,6 +1178,7 @@ def peek_batched(
     return walks_batched(
         slab, en, stage, off, ver, vlen,
         is_remove=ones, want_out=ones, max_walk=max_walk, collect=remove,
+        hot_entries=hot_entries,
     )
 
 
@@ -1021,7 +1187,7 @@ def peek_batched(
 # sequential mode additionally inlines them under its own jit, where these
 # wrappers are free).  The batched kernels are always called under the
 # engine's jit and need no wrappers.
-put_first = jax.jit(put_first)
-put = jax.jit(put)
-branch = jax.jit(branch, static_argnames=("max_walk",))
-peek = jax.jit(peek, static_argnames=("max_walk", "remove"))
+put_first = jax.jit(put_first, static_argnames=("hot_entries",))
+put = jax.jit(put, static_argnames=("hot_entries",))
+branch = jax.jit(branch, static_argnames=("max_walk", "hot_entries"))
+peek = jax.jit(peek, static_argnames=("max_walk", "remove", "hot_entries"))

@@ -41,19 +41,26 @@ from kafkastreams_cep_tpu.ops.slab import SlabState
 
 LANE_BLOCK = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams across the versions this
+# engine runs on (laptop CI pins an older jaxlib than the TPU hosts).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def _kernel(
     # inputs (lane-last blocks)
     stage, off, refs, npreds, pstage, poff, pvlen, pver, missing, trunc,
-    fulld, predd,
+    fulld, predd, hh, hm, ow, dm,
     p_first, p_cur, p_pstage, p_poff, p_vlen, p_ver, p_rank, p_nen, ev_off,
     en, wstage, woff, wvlen, wver, wrem, wout, rank, nen,
     # outputs
     o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
-    o_missing, o_trunc, o_fulld, o_predd, o_ostage, o_ooff, o_count,
-    # scratch
-    st_stage, st_off,
-    *, W: int, out_base: int, out_rows: int, with_puts: bool,
+    o_missing, o_trunc, o_fulld, o_predd, o_hh, o_hm, o_ow, o_dm,
+    o_ostage, o_ooff, o_count,
+    # scratch (tier_scratch is empty unless EH > 0)
+    st_stage, st_off, *tier_scratch,
+    W: int, out_base: int, out_rows: int, with_puts: bool, EH: int,
 ):
     E, MP, L = pstage.shape
     # pver blocks arrive [D, E, MP, L]: the tiled trailing dims are then
@@ -64,6 +71,15 @@ def _kernel(
     PW = en.shape[0]
     OR = out_rows
     i32 = jnp.int32
+    # Two-tier layout (ops/slab.py "Two-tier layout" note): rows [0, EHk)
+    # are the hot tier, [EHk, E) the overflow tier.  EH == 0 instantiates
+    # the legacy single tier as EHk = E / EO = 0 — every overflow-side
+    # block below is then skipped at trace time and the hot-side code IS
+    # the original full-slab code.
+    EHk = EH if EH else E
+    EO = E - EHk
+    if EO:
+        (sc_found, sc_refs, sc_np, sc_ps, sc_po, sc_pl, sc_pv) = tier_scratch
 
     # Working state lives in the output refs (VMEM) for the whole pass.
     o_stage[:] = stage[:]
@@ -78,6 +94,10 @@ def _kernel(
     o_trunc[:] = trunc[:]
     o_fulld[:] = fulld[:]
     o_predd[:] = predd[:]
+    o_hh[:] = hh[:]
+    o_hm[:] = hm[:]
+    o_ow[:] = ow[:]
+    o_dm[:] = dm[:]
     o_ostage[:] = jnp.full((OR, W, L), -1, i32)
     o_ooff[:] = jnp.full((OR, W, L), -1, i32)
     o_count[:] = jnp.zeros((OR, L), i32)
@@ -85,10 +105,15 @@ def _kernel(
     iota_pw = jax.lax.broadcasted_iota(i32, (PW, L), 0)
     iota_mp = jax.lax.broadcasted_iota(i32, (MP, L), 0)
     iota_mp3 = jax.lax.broadcasted_iota(i32, (E, MP, L), 1)
+    iota_mp3h = jax.lax.broadcasted_iota(i32, (EHk, MP, L), 1)
     iota_d3 = jax.lax.broadcasted_iota(i32, (D, MP, L), 0)
     iota_or3 = jax.lax.broadcasted_iota(i32, (OR, W, L), 0)
     iota_w2 = jax.lax.broadcasted_iota(i32, (W, L), 0)
     iota_or2 = jax.lax.broadcasted_iota(i32, (OR, L), 0)
+    iota_eh = jax.lax.broadcasted_iota(i32, (EHk, L), 0)
+    if EO:
+        iota_eo = jax.lax.broadcasted_iota(i32, (EO, L), 0)
+        iota_mp3o = jax.lax.broadcasted_iota(i32, (EO, MP, L), 1)
 
     # ---- consuming-put phase (reference order precedes all walks; one
     # put per lane per batch in queue-order rank = the sequential
@@ -128,10 +153,88 @@ def _kernel(
             cur_hit = (o_stage[:] == cur) & (o_off[:] == off_l)  # [E, L]
             exist = jnp.any(cur_hit, axis=0, keepdims=True)
             free = o_stage[:] < 0
-            ffs = jnp.min(jnp.where(free, iota_e, E), axis=0, keepdims=True)
-            has_free = ffs < E
+            # Two-tier allocation: new entries always land hot — a free hot
+            # slot, else the least-recent (min off, lowest index) hot entry
+            # demotes into a free overflow slot and frees its own.  Drops
+            # happen only when the WHOLE slab is full, exactly the single-
+            # tier condition (EO == 0 makes this the legacy path verbatim).
+            free_h = free[0:EHk]
+            ffs_h = jnp.min(
+                jnp.where(free_h, iota_eh, EHk), axis=0, keepdims=True
+            )
+            any_fh = ffs_h < EHk
+            if EO:
+                free_o = free[EHk:]
+                ffs_o = jnp.min(
+                    jnp.where(free_o, iota_eo, EO), axis=0, keepdims=True
+                )
+                any_fo = ffs_o < EO
+                okey = jnp.where(
+                    ~free_h, o_off[0:EHk], jnp.int32(1 << 30)
+                )
+                vkey = jnp.min(okey, axis=0, keepdims=True)
+                vslot = jnp.min(
+                    jnp.where(okey == vkey, iota_eh, EHk),
+                    axis=0, keepdims=True,
+                )
+                demote = en_ok & ~exist & ~any_fh & any_fo
+                o_dm[:] = o_dm[:] + jnp.where(demote, 1, 0)
+
+                @pl.when(jnp.any(demote))
+                def _():
+                    vm = (iota_eh == vslot) & demote  # [EHk, L]
+                    om = (iota_eo == ffs_o) & demote  # [EO, L]
+
+                    def mv2(ref):
+                        v = jnp.sum(
+                            jnp.where(vm, ref[0:EHk], 0),
+                            axis=0, keepdims=True,
+                        )
+                        ref[EHk:] = jnp.where(om, v, ref[EHk:])
+
+                    mv2(o_refs)
+                    mv2(o_npreds)
+
+                    def mv3(ref):
+                        v = jnp.sum(
+                            jnp.where(vm[:, None, :], ref[0:EHk], 0), axis=0
+                        )  # [MP, L]
+                        ref[EHk:] = jnp.where(
+                            om[:, None, :], v[None], ref[EHk:]
+                        )
+
+                    mv3(o_pstage)
+                    mv3(o_poff)
+                    mv3(o_pvlen)
+                    v4 = jnp.sum(
+                        jnp.where(
+                            vm[None, :, None, :], o_pver[:, 0:EHk], 0
+                        ),
+                        axis=1,
+                    )  # [D, MP, L]
+                    o_pver[:, EHk:] = jnp.where(
+                        om[None, :, None, :], v4[:, None], o_pver[:, EHk:]
+                    )
+                    vstage = jnp.sum(
+                        jnp.where(vm, o_stage[0:EHk], 0),
+                        axis=0, keepdims=True,
+                    )
+                    voff = jnp.sum(
+                        jnp.where(vm, o_off[0:EHk], 0),
+                        axis=0, keepdims=True,
+                    )
+                    o_stage[EHk:] = jnp.where(om, vstage, o_stage[EHk:])
+                    o_off[EHk:] = jnp.where(om, voff, o_off[EHk:])
+                    o_stage[0:EHk] = jnp.where(vm, -1, o_stage[0:EHk])
+                    o_off[0:EHk] = jnp.where(vm, -1, o_off[0:EHk])
+
+                alloc = jnp.where(any_fh, ffs_h, vslot)
+                has_free = any_fh | any_fo
+            else:
+                alloc = ffs_h
+                has_free = any_fh
             # Boolean algebra, not where(): Mosaic can't select i1 vectors.
-            tgt = (exist & cur_hit) | (~exist & (iota_e == ffs))  # [E, L]
+            tgt = (exist & cur_hit) | (~exist & (iota_e == alloc))  # [E, L]
             ok = en_ok & (exist | has_free)
             o_fulld[:] = o_fulld[:] + jnp.where(
                 en_ok & ~exist & ~has_free, 1, 0
@@ -203,22 +306,85 @@ def _kernel(
         def hop_body(c):
             h, active_i, cs, co, qv, ql, cnt = c
             active = active_i != 0
-            hit = (o_stage[:] == cs) & (o_off[:] == co)  # [E, L]
-            found = jnp.any(hit, axis=0, keepdims=True)  # [1, L]
+            # Hot-tier lookup first: [EHk, L] compares instead of [E, L].
+            # The overflow rows are consulted only when some lane of the
+            # block missed hot — the common all-hot hop never touches them
+            # (the E-linear -> E_hot-linear win of the two-tier layout).
+            hit_h = (o_stage[0:EHk] == cs) & (o_off[0:EHk] == co)
+            found_h = jnp.any(hit_h, axis=0, keepdims=True)  # [1, L]
+            if EO:
+                miss = active & ~found_h
+                sc_found[:] = jnp.zeros((1, L), i32)
+                sc_refs[:] = jnp.zeros((1, L), i32)
+                sc_np[:] = jnp.zeros((1, L), i32)
+                sc_ps[:] = jnp.zeros((MP, L), i32)
+                sc_po[:] = jnp.zeros((MP, L), i32)
+                sc_pl[:] = jnp.zeros((MP, L), i32)
+                sc_pv[:] = jnp.zeros((D, MP, L), i32)
+
+                @pl.when(jnp.any(miss))
+                def _():
+                    hit_o = (o_stage[EHk:] == cs) & (o_off[EHk:] == co)
+                    hamo = hit_o & miss  # [EO, L]
+                    sc_found[:] = jnp.where(
+                        jnp.any(hamo, axis=0, keepdims=True), 1, 0
+                    )
+                    sc_refs[:] = jnp.sum(
+                        jnp.where(hamo, o_refs[EHk:], 0),
+                        axis=0, keepdims=True,
+                    )
+                    sc_np[:] = jnp.sum(
+                        jnp.where(hamo, o_npreds[EHk:], 0),
+                        axis=0, keepdims=True,
+                    )
+                    hamo3 = hamo[:, None, :]
+                    sc_ps[:] = jnp.sum(
+                        jnp.where(hamo3, o_pstage[EHk:], 0), axis=0
+                    )
+                    sc_po[:] = jnp.sum(
+                        jnp.where(hamo3, o_poff[EHk:], 0), axis=0
+                    )
+                    sc_pl[:] = jnp.sum(
+                        jnp.where(hamo3, o_pvlen[EHk:], 0), axis=0
+                    )
+                    sc_pv[:] = jnp.sum(
+                        jnp.where(
+                            hamo[None, :, None, :], o_pver[:, EHk:], 0
+                        ),
+                        axis=1,
+                    )
+
+                act_o = sc_found[:] != 0  # active walkers resolved overflow
+                found = found_h | act_o
+                o_hh[:] = o_hh[:] + jnp.where(active & found_h, 1, 0)
+                o_hm[:] = o_hm[:] + jnp.where(miss, 1, 0)
+                o_ow[:] = o_ow[:] + jnp.where(act_o, 1, 0)
+            else:
+                act_o = jnp.zeros((1, L), jnp.bool_)
+                found = found_h
             o_missing[:] = o_missing[:] + jnp.where(active & ~found, 1, 0)
             active = active & found
-            ham = hit & active  # [E, L] — <=1 True per lane (unique keys)
+            ham_h = hit_h & active  # [EHk, L] — <=1 True/lane (unique keys)
 
-            refs_e = jnp.sum(jnp.where(ham, o_refs[:], 0), axis=0, keepdims=True)
+            refs_e = jnp.sum(
+                jnp.where(ham_h, o_refs[0:EHk], 0), axis=0, keepdims=True
+            )
+            np_e = jnp.sum(
+                jnp.where(ham_h, o_npreds[0:EHk], 0), axis=0, keepdims=True
+            )
+            if EO:
+                # Per-lane sums pick the single hit entry, so the hot and
+                # staged-overflow contributions are disjoint: add them.
+                refs_e = refs_e + sc_refs[:]
+                np_e = np_e + sc_np[:]
             # Remove-walkers decrement (floored at zero,
             # TimedKeyValue.java:59-61); branch walkers increment.
             newref = jnp.where(wrm, jnp.maximum(refs_e - 1, 0), refs_e + 1)
-            o_refs[:] = jnp.where(ham, newref, o_refs[:])
-            np_e = jnp.sum(jnp.where(ham, o_npreds[:], 0), axis=0, keepdims=True)
+            o_refs[0:EHk] = jnp.where(ham_h, newref, o_refs[0:EHk])
             dele = active & wrm & (newref == 0) & (np_e <= 1)
-            dmask = ham & dele
-            o_stage[:] = jnp.where(dmask, -1, o_stage[:])
-            o_off[:] = jnp.where(dmask, -1, o_off[:])
+            dmask = ham_h & dele
+            o_stage[0:EHk] = jnp.where(dmask, -1, o_stage[0:EHk])
+            o_off[0:EHk] = jnp.where(dmask, -1, o_off[0:EHk])
 
             # Emit the hop for extraction walkers into the per-batch [W, L]
             # staging buffer (scattering straight into the [OR, W, L] output
@@ -229,15 +395,22 @@ def _kernel(
             st_off[:] = jnp.where(mw, co, st_off[:])
             cnt = cnt + jnp.where(emit, 1, 0)
 
-            # The hit entry's pointer rows (masked reduce over E — the slab
-            # stays in VMEM, so this is pure vector work).
-            ham3 = ham[:, None, :]
-            ps_ = jnp.sum(jnp.where(ham3, o_pstage[:], 0), axis=0)  # [MP, L]
-            po_ = jnp.sum(jnp.where(ham3, o_poff[:], 0), axis=0)
-            pl_ = jnp.sum(jnp.where(ham3, o_pvlen[:], 0), axis=0)
+            # The hit entry's pointer rows (masked reduce over the hot rows
+            # — the slab stays in VMEM, so this is pure vector work; the
+            # overflow contribution was staged under the miss branch).
+            ham3 = ham_h[:, None, :]
+            ps_ = jnp.sum(jnp.where(ham3, o_pstage[0:EHk], 0), axis=0)
+            po_ = jnp.sum(jnp.where(ham3, o_poff[0:EHk], 0), axis=0)
+            pl_ = jnp.sum(jnp.where(ham3, o_pvlen[0:EHk], 0), axis=0)
             pv_ = jnp.sum(
-                jnp.where(ham[None, :, None, :], o_pver[:], 0), axis=1
+                jnp.where(ham_h[None, :, None, :], o_pver[:, 0:EHk], 0),
+                axis=1,
             )  # [D, MP, L]
+            if EO:
+                ps_ = ps_ + sc_ps[:]
+                po_ = po_ + sc_po[:]
+                pl_ = pl_ + sc_pl[:]
+                pv_ = pv_ + sc_pv[:]
             live = iota_mp < np_e  # [MP, L]
 
             # dewey_ops.is_compatible vectorized over the MP pointers
@@ -271,13 +444,14 @@ def _kernel(
             # (entry, slots >= j), last slot keeping its own value
             # (TimedKeyValue.removePredecessor).
             prune = selany & active & wrm & (newref == 0)
+            prune_h = prune & found_h
 
-            @pl.when(jnp.any(prune))
+            @pl.when(jnp.any(prune_h))
             def _():
-                pm = ham3 & (iota_mp3 >= j[None]) & prune[None]  # [E, MP, L]
+                pm = ham3 & (iota_mp3h >= j[None]) & prune_h[None]
 
-                def shift(ref, m, axis=1):
-                    f = ref[:]
+                def shift(get, put, m, axis=1):
+                    f = get()
                     nxt = jnp.concatenate(
                         [
                             jax.lax.slice_in_dim(f, 1, None, axis=axis),
@@ -285,13 +459,76 @@ def _kernel(
                         ],
                         axis=axis,
                     )
-                    ref[:] = jnp.where(m, nxt, f)
+                    put(jnp.where(m, nxt, f))
 
-                shift(o_pstage, pm)
-                shift(o_poff, pm)
-                shift(o_pvlen, pm)
-                shift(o_pver, pm[None], axis=2)
-                o_npreds[:] = o_npreds[:] - jnp.where(ham & prune, 1, 0)
+                def set_h(ref):
+                    def put(v):
+                        ref[0:EHk] = v
+                    return put
+
+                shift(lambda: o_pstage[0:EHk], set_h(o_pstage), pm)
+                shift(lambda: o_poff[0:EHk], set_h(o_poff), pm)
+                shift(lambda: o_pvlen[0:EHk], set_h(o_pvlen), pm)
+
+                def put_pver(v):
+                    o_pver[:, 0:EHk] = v
+
+                shift(lambda: o_pver[:, 0:EHk], put_pver, pm[None], axis=2)
+                o_npreds[0:EHk] = o_npreds[0:EHk] - jnp.where(
+                    ham_h & prune_h, 1, 0
+                )
+
+            if EO:
+                # One overflow-side mutation pass serves refs decrement,
+                # delete, and prune for walkers resolved in the overflow
+                # tier; recomputing the [EO, L] hit is cheaper than staging
+                # [EO, ...] masks, and the pass is skipped whenever every
+                # lane of the block resolved hot.
+                @pl.when(jnp.any(act_o))
+                def _():
+                    hit_o = (o_stage[EHk:] == cs) & (o_off[EHk:] == co)
+                    hamo = hit_o & act_o  # [EO, L]
+                    o_refs[EHk:] = jnp.where(hamo, newref, o_refs[EHk:])
+                    dmo = hamo & dele
+                    o_stage[EHk:] = jnp.where(dmo, -1, o_stage[EHk:])
+                    o_off[EHk:] = jnp.where(dmo, -1, o_off[EHk:])
+                    prune_o = prune & act_o
+                    pmo = (
+                        hamo[:, None, :]
+                        & (iota_mp3o >= j[None])
+                        & prune_o[None]
+                    )
+
+                    def shift_o(get, put, m, axis=1):
+                        f = get()
+                        nxt = jnp.concatenate(
+                            [
+                                jax.lax.slice_in_dim(f, 1, None, axis=axis),
+                                jax.lax.slice_in_dim(f, -1, None, axis=axis),
+                            ],
+                            axis=axis,
+                        )
+                        put(jnp.where(m, nxt, f))
+
+                    def set_o(ref):
+                        def put(v):
+                            ref[EHk:] = v
+                        return put
+
+                    shift_o(lambda: o_pstage[EHk:], set_o(o_pstage), pmo)
+                    shift_o(lambda: o_poff[EHk:], set_o(o_poff), pmo)
+                    shift_o(lambda: o_pvlen[EHk:], set_o(o_pvlen), pmo)
+
+                    def put_pver_o(v):
+                        o_pver[:, EHk:] = v
+
+                    shift_o(
+                        lambda: o_pver[:, EHk:], put_pver_o, pmo[None],
+                        axis=2,
+                    )
+                    o_npreds[EHk:] = o_npreds[EHk:] - jnp.where(
+                        hamo & prune_o, 1, 0
+                    )
 
             nxt_s = jnp.sum(jnp.where(ohj, ps_, 0), axis=0, keepdims=True)
             nxt_o = jnp.sum(jnp.where(ohj, po_, 0), axis=0, keepdims=True)
@@ -343,7 +580,9 @@ def _from_lane_last(x):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_walk", "out_base", "out_rows", "interpret"),
+    static_argnames=(
+        "max_walk", "out_base", "out_rows", "interpret", "hot_entries",
+    ),
 )
 def walk_pass_kernel(
     slab: SlabState,
@@ -360,6 +599,7 @@ def walk_pass_kernel(
     interpret: bool = False,
     put_ops=None,
     ev_off=None,
+    hot_entries: int = 0,
 ) -> Tuple[SlabState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The step's walk pass for a ``[K]``-batched slab via the fused kernel.
 
@@ -372,6 +612,15 @@ def walk_pass_kernel(
     apply in-kernel BEFORE the walks — same contract as ``jax.vmap`` of
     ``puts_batched`` — so the slab crosses HBM once per step instead of
     twice.
+
+    ``hot_entries`` > 0 enables the two-tier layout (ops/slab.py
+    "Two-tier layout"): allocation prefers the hot rows (demoting the
+    min-off hot entry when full), each hop's lookup/reduce runs over the
+    hot rows, and the overflow rows are touched only under a block-level
+    ``pl.when`` that skips when every lane of the block resolved hot —
+    the common hop pays an E_hot-sized reduce instead of an E-sized one.
+    Bit-exact (including the residency counters) with ``jax.vmap`` of the
+    jnp path at the same ``hot_entries``.
     """
     i32 = jnp.int32
     K, E = slab.stage.shape
@@ -382,6 +631,11 @@ def walk_pass_kernel(
     OR = out_rows
     if K % LANE_BLOCK:
         raise ValueError(f"K={K} not a multiple of {LANE_BLOCK}")
+    if hot_entries and (hot_entries % 8 or not 0 < hot_entries < E):
+        raise ValueError(
+            f"hot_entries={hot_entries} must be a multiple of 8 strictly "
+            f"below slab_entries={E}"
+        )
 
     en_i = en.astype(i32)
     rank = jnp.where(en, jnp.cumsum(en_i, axis=1) - 1, -1)
@@ -428,6 +682,10 @@ def walk_pass_kernel(
         row(slab.trunc),
         row(slab.full_drops),
         row(slab.pred_drops),
+        row(slab.hot_hits),
+        row(slab.hot_misses),
+        row(slab.overflow_walks),
+        row(slab.demotions),
         *put_ins,
         tin(en_i),
         tin(jnp.asarray(stage, i32)),
@@ -466,33 +724,52 @@ def walk_pass_kernel(
         jax.ShapeDtypeStruct((1, K), i32),  # trunc
         jax.ShapeDtypeStruct((1, K), i32),  # full_drops
         jax.ShapeDtypeStruct((1, K), i32),  # pred_drops
+        jax.ShapeDtypeStruct((1, K), i32),  # hot_hits
+        jax.ShapeDtypeStruct((1, K), i32),  # hot_misses
+        jax.ShapeDtypeStruct((1, K), i32),  # overflow_walks
+        jax.ShapeDtypeStruct((1, K), i32),  # demotions
         jax.ShapeDtypeStruct((OR, W, K), i32),  # out_stage
         jax.ShapeDtypeStruct((OR, W, K), i32),  # out_off
         jax.ShapeDtypeStruct((OR, K), i32),  # count
     ]
     out_specs = [bspec(tuple(s.shape[:-1]) + (L,)) for s in out_shapes]
 
+    scratch_shapes = [
+        pltpu.VMEM((W, LANE_BLOCK), jnp.int32),
+        pltpu.VMEM((W, LANE_BLOCK), jnp.int32),
+    ]
+    if hot_entries:
+        # Per-hop staging of the overflow tier's contribution (written only
+        # under the miss branch, read unconditionally in the combine).
+        scratch_shapes += [
+            pltpu.VMEM((1, LANE_BLOCK), jnp.int32),  # sc_found
+            pltpu.VMEM((1, LANE_BLOCK), jnp.int32),  # sc_refs
+            pltpu.VMEM((1, LANE_BLOCK), jnp.int32),  # sc_np
+            pltpu.VMEM((MP, LANE_BLOCK), jnp.int32),  # sc_ps
+            pltpu.VMEM((MP, LANE_BLOCK), jnp.int32),  # sc_po
+            pltpu.VMEM((MP, LANE_BLOCK), jnp.int32),  # sc_pl
+            pltpu.VMEM((D, MP, LANE_BLOCK), jnp.int32),  # sc_pv
+        ]
+
     outs = pl.pallas_call(
         functools.partial(
             _kernel, W=W, out_base=out_base, out_rows=out_rows,
-            with_puts=with_puts,
+            with_puts=with_puts, EH=hot_entries,
         ),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((W, LANE_BLOCK), jnp.int32),
-            pltpu.VMEM((W, LANE_BLOCK), jnp.int32),
-        ],
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(*ins)
 
     (n_stage, n_off, n_refs, n_npreds, n_pstage, n_poff, n_pvlen, n_pver,
-     n_missing, n_trunc, n_fulld, n_predd, o_stage, o_off, o_count) = outs
+     n_missing, n_trunc, n_fulld, n_predd, n_hh, n_hm, n_ow, n_dm,
+     o_stage, o_off, o_count) = outs
     new_slab = slab._replace(
         stage=tout(n_stage),
         off=tout(n_off),
@@ -506,6 +783,10 @@ def walk_pass_kernel(
         trunc=unrow(n_trunc),
         full_drops=unrow(n_fulld),
         pred_drops=unrow(n_predd),
+        hot_hits=unrow(n_hh),
+        hot_misses=unrow(n_hm),
+        overflow_walks=unrow(n_ow),
+        demotions=unrow(n_dm),
     )
     return (
         new_slab,

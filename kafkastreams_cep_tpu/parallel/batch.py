@@ -17,16 +17,65 @@ import jax.numpy as jnp
 
 from kafkastreams_cep_tpu.engine.matcher import (
     COUNTER_NAMES,
+    HOT_COUNTER_NAMES,
     EngineConfig,
     EngineState,
     EventBatch,
     StepOutput,
     TPUMatcher,
     counter_values,
+    hot_counter_values,
 )
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("parallel.batch")
+
+# Exception-type names and message fragments that identify a Mosaic/Pallas
+# lowering or compilation failure — the only failure class that justifies
+# permanently abandoning the fused kernel for a pattern.  Everything else
+# (RESOURCE_EXHAUSTED on a transient OOM, cancelled/interrupted calls,
+# data-dependent runtime faults) must propagate and leave the kernel armed.
+_LOWERING_ERROR_TYPES = (NotImplementedError,)
+_LOWERING_ERROR_TYPE_NAMES = (
+    "LoweringError",
+    "LoweringException",
+    "MosaicError",
+    "VerificationError",
+)
+_LOWERING_ERROR_MARKERS = (
+    "mosaic",
+    "pallas",
+    "lowering",
+    "unsupported",
+    "not implemented",
+    "cannot lower",
+    "vmem",
+    "relayout",
+    "bitcast_vreg",
+)
+_TRANSIENT_MARKERS = (
+    "resource_exhausted",
+    "interrupted",
+    "cancelled",
+    "deadline",
+    "unavailable",
+)
+
+
+def is_lowering_error(e: BaseException) -> bool:
+    """Classify an exception from a fused-kernel call: ``True`` for
+    Mosaic/Pallas lowering/compilation failures (pattern cannot lower —
+    fall back permanently), ``False`` for anything transient or unknown
+    (re-raise; the kernel stays enabled for the next call)."""
+    if isinstance(e, _LOWERING_ERROR_TYPES):
+        return True
+    msg = str(e).lower()
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return False
+    for cls in type(e).__mro__:
+        if cls.__name__ in _LOWERING_ERROR_TYPE_NAMES:
+            return True
+    return any(m in msg for m in _LOWERING_ERROR_MARKERS)
 
 
 def broadcast_state(state: EngineState, num_lanes: int) -> EngineState:
@@ -90,6 +139,7 @@ def kernel_lane_step(phases, interpret: bool = False, qids=None):
             max_walk=ph.max_walk, out_base=ph.out_base,
             out_rows=ph.out_rows, interpret=interpret,
             put_ops=ops, ev_off=ev.off,
+            hot_entries=ph.hot_entries,
         )
         if qids is None:
             return jax.vmap(ph.finish)(
@@ -236,7 +286,11 @@ class BatchMatcher:
         """The whole-scan kernel traces user predicates INTO the Pallas
         program, so a pattern that doesn't lower to Mosaic fails at the
         first compiled call, not at build time — catch that call and
-        permanently fall back to the per-step path."""
+        permanently fall back to the per-step path.  Only
+        lowering/compilation failures trigger the permanent fallback
+        (:func:`is_lowering_error`); transient runtime errors — device OOM,
+        interrupts, preemption — propagate so one bad call cannot silently
+        disable the kernel for the rest of the process."""
         fast = jax.jit(full_scan)
         slow = None
 
@@ -246,6 +300,8 @@ class BatchMatcher:
                 try:
                     return fast(state, events)
                 except Exception as e:
+                    if not is_lowering_error(e):
+                        raise
                     logger.warning(
                         "whole-scan kernel failed to lower (%s); falling "
                         "back to the per-step path", e,
@@ -285,4 +341,12 @@ class BatchMatcher:
         return {
             n: int(jnp.sum(v))
             for n, v in zip(COUNTER_NAMES, counter_values(state))
+        }
+
+    def hot_counters(self, state: EngineState) -> Dict[str, int]:
+        """Two-tier residency telemetry summed over all lanes (all zero
+        when ``slab_hot_entries == 0``)."""
+        return {
+            n: int(jnp.sum(v))
+            for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
         }

@@ -21,20 +21,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kafkastreams_cep_tpu.engine.matcher import (
     COUNTER_NAMES,
+    HOT_COUNTER_NAMES,
     EngineConfig,
     EngineState,
     EventBatch,
     TPUMatcher,
     counter_values,
+    hot_counter_values,
 )
 from kafkastreams_cep_tpu.parallel.batch import (
     _select_walk_kernel,
     broadcast_state,
+    is_lowering_error,
     kernel_lane_scan,
     kernel_lane_step,
     lane_scan,
     lane_step,
 )
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.sharding")
+
+
+def _shard_map(*args, **kwargs):
+    """``jax.shard_map`` with fallback to the pre-0.5 experimental home
+    (the engine runs on older jaxlib in CI than on the TPU hosts).
+    ``check_vma`` was spelled ``check_rep`` there."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return fn(*args, **kwargs)
 
 
 def key_mesh(devices: Optional[Sequence] = None, axis: str = "keys") -> Mesh:
@@ -93,6 +112,7 @@ class ShardedMatcher:
         # BatchMatcher): lanes never cross shards, so each shard's block
         # is an ordinary lane batch for the fused program.
         self.uses_scan_kernel = False
+        fallback_local_scan = local_scan
         scan_mode = __import__("os").environ.get("CEP_SCAN_KERNEL", "0")
         if scan_mode in ("1", "interpret"):
             from kafkastreams_cep_tpu.ops import scan_kernel
@@ -104,23 +124,62 @@ class ShardedMatcher:
                 full.interpret = scan_mode == "interpret"
                 local_scan = full
                 self.uses_scan_kernel = True
+            else:
+                logger.warning(
+                    "CEP_SCAN_KERNEL=%s requested but per-shard lane count "
+                    "%d is not a multiple of %d — using the per-step path",
+                    scan_mode, self.num_lanes // n, scan_kernel.LANE_BLOCK,
+                )
 
         def local_stats(state):
             local = jnp.stack(
                 [jnp.sum(v) for v in counter_values(state)]
                 + [jnp.sum(state.alive)]
+                + [jnp.sum(v) for v in hot_counter_values(state)]
             )
             return jax.lax.psum(local, self.axis)
 
         # check_vma off: constants born inside fori_loop carries are
         # device-invariant and trip the varying-axes check; the hot path has
         # no collectives, so the replication analysis buys nothing here.
-        shard = lambda f, out_specs: jax.shard_map(
+        shard = lambda f, out_specs: _shard_map(
             f, mesh=mesh, in_specs=spec, out_specs=out_specs, check_vma=False
         )
         self.step = jax.jit(shard(local_step, spec))
-        self.scan = jax.jit(shard(local_scan, spec))
+        if self.uses_scan_kernel:
+            # Same guarded first call as BatchMatcher._with_fallback: the
+            # kernel traces user predicates, so a pattern that cannot lower
+            # to Mosaic fails at the first compiled call — fall back to the
+            # per-step sharded path then, and only then (transient runtime
+            # errors propagate and leave the kernel armed).
+            self.scan = self._scan_with_fallback(
+                jax.jit(shard(local_scan, spec)),
+                lambda: jax.jit(shard(fallback_local_scan, spec)),
+            )
+        else:
+            self.scan = jax.jit(shard(local_scan, spec))
         self._stats = jax.jit(shard(local_stats, P()))
+
+    def _scan_with_fallback(self, fast, make_slow):
+        slow = None
+
+        def scan(state, events):
+            nonlocal slow
+            if slow is None:
+                try:
+                    return fast(state, events)
+                except Exception as e:
+                    if not is_lowering_error(e):
+                        raise
+                    logger.warning(
+                        "sharded whole-scan kernel failed to lower (%s); "
+                        "falling back to the per-step path", e,
+                    )
+                    self.uses_scan_kernel = False
+                    slow = make_slow()
+            return slow(state, events)
+
+        return scan
 
     @property
     def names(self):
@@ -137,7 +196,7 @@ class ShardedMatcher:
     def stats(self, state: EngineState) -> Dict[str, int]:
         """Mesh-global counter totals (one ``psum`` across all shards)."""
         vals = jax.device_get(self._stats(state))
-        keys = COUNTER_NAMES + ("alive_runs",)
+        keys = COUNTER_NAMES + ("alive_runs",) + HOT_COUNTER_NAMES
         return {k: int(v) for k, v in zip(keys, vals)}
 
     def counters(self, state: EngineState) -> Dict[str, int]:
@@ -146,6 +205,11 @@ class ShardedMatcher:
         supervisor, checkpoint) is matcher-agnostic."""
         stats = self.stats(state)
         return {k: stats[k] for k in COUNTER_NAMES}
+
+    def hot_counters(self, state: EngineState) -> Dict[str, int]:
+        """Two-tier residency telemetry totals (BatchMatcher interface)."""
+        stats = self.stats(state)
+        return {k: stats[k] for k in HOT_COUNTER_NAMES}
 
     def sweep(self, state: EngineState) -> EngineState:
         """Slab mark-sweep over every shard (lane-elementwise — XLA keeps
@@ -164,7 +228,7 @@ class ShardedMatcher:
 
         spec = P(self.axis)
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local, mesh=self.mesh, in_specs=spec, out_specs=spec,
                 check_vma=False,
             )

@@ -142,6 +142,18 @@ class StackedBankMatcher:
             for n, v in zip(COUNTER_NAMES, counter_values(state))
         }
 
+    def hot_counters(self, state: EngineState) -> Dict[str, int]:
+        """Two-tier residency telemetry summed over all lanes."""
+        from kafkastreams_cep_tpu.engine.matcher import (
+            HOT_COUNTER_NAMES,
+            hot_counter_values,
+        )
+
+        return {
+            n: int(jnp.sum(v))
+            for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
+        }
+
 
 def choose_bank(
     patterns: Sequence,

@@ -405,8 +405,20 @@ class CEPProcessor:
         from the packed columns (schema dtypes), not the caller's original
         scalars."""
         keys_arr = np.asarray(keys)
+        if keys_arr.ndim != 1:
+            raise ValueError(
+                f"keys must be a 1-D column, got shape {keys_arr.shape}"
+            )
         ts_arr = np.asarray(timestamps, dtype=np.int64)
         n = int(keys_arr.shape[0])
+        # One timestamp per record, validated BEFORE the native pack path:
+        # pack_column dereferences n column elements by row, so a short
+        # timestamps column would be an out-of-bounds read, not an error.
+        if ts_arr.shape != (n,):
+            raise ValueError(
+                f"timestamps shape {ts_arr.shape} != ({n},); pass exactly "
+                "one timestamp per record"
+            )
         if n == 0:
             return []
         K = self.num_lanes
@@ -505,6 +517,20 @@ class CEPProcessor:
                 in_range, keys_arr.astype(np.int64),
                 lanes_arr.astype(np.int64),
             ).astype(np.int32)
+        elif keys_arr.dtype == object:
+            # Object columns can mix int and non-int keys; each element
+            # must take the code _key_code gives it on the record path (an
+            # in-range int keeps its value, anything else its lane index),
+            # or record- and column-ingested events of the SAME key would
+            # see different ``key`` values in predicates.
+            key_codes = np.fromiter(
+                (
+                    self._key_code(k, int(lanes_arr[i]))
+                    for i, k in enumerate(keys_arr.tolist())
+                ),
+                dtype=np.int32,
+                count=n,
+            )
         else:
             key_codes = lanes_arr.astype(np.int32)
         key_arr = np.zeros((K, T), dtype=np.int32)

@@ -58,6 +58,11 @@ def mk_slab():
         pred_drops=jnp.zeros((K,), i32),
         missing=jnp.zeros((K,), i32),
         trunc=jnp.zeros((K,), i32),
+        collisions=jnp.zeros((K,), i32),
+        hot_hits=jnp.zeros((K,), i32),
+        hot_misses=jnp.zeros((K,), i32),
+        overflow_walks=jnp.zeros((K,), i32),
+        demotions=jnp.zeros((K,), i32),
     )
 
 

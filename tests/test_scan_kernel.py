@@ -47,7 +47,8 @@ def assert_state_equal(st_k, st_ref):
             np.asarray(getattr(st_ref, name)), err_msg=name,
         )
     for name in ("stage", "off", "refs", "npreds", "full_drops",
-                 "pred_drops", "missing", "trunc"):
+                 "pred_drops", "missing", "trunc", "hot_hits",
+                 "hot_misses", "overflow_walks", "demotions"):
         np.testing.assert_array_equal(
             np.asarray(getattr(st_k.slab, name)),
             np.asarray(getattr(st_ref.slab, name)), err_msg=f"slab.{name}",

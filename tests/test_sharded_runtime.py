@@ -240,4 +240,5 @@ def test_sharded_scan_exact_stats_and_outputs(mesh8):
     np.testing.assert_array_equal(np.asarray(sout.off), np.asarray(bout.off))
     expect = dict(ref_counters)
     expect["alive_runs"] = int(jnp.sum(bstate.alive))
+    expect.update(batch.hot_counters(bstate))
     assert sharded.stats(sstate) == expect
