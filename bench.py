@@ -814,6 +814,108 @@ def bench_processor(K, T, n_batches):
     return n_batches * N / dt
 
 
+def bench_resilience():
+    """Supervisor fault-path latencies (ISSUE 2: track them across PRs).
+
+    Three numbers, all wall-clock on this environment:
+
+    * ``checkpoint_s`` — one full snapshot (state device_get + pickle);
+    * ``recover_s``    — one restore-and-replay cycle (checkpoint restore,
+      which recompiles the matcher, + journal-tail replay);
+    * ``escalate_s``   — one capacity escalation end-to-end: rollback,
+      live-state migration onto the wider config (another compile),
+      post-escalation snapshot, and the re-processed batch.
+
+    Both recovery and escalation are compile-dominated: each builds a
+    fresh matcher, so the persistent compilation cache is the main lever
+    (PROFILE_r06.md context).  Sizes kept small — these are latency
+    probes, not throughput lines.
+    """
+    import shutil
+    import tempfile
+
+    from kafkastreams_cep_tpu.engine.sizing import EscalationPolicy
+    from kafkastreams_cep_tpu.runtime import Record, Supervisor
+
+    workdir = tempfile.mkdtemp(prefix="cep_bench_resil_")
+    out = {}
+    try:
+        K = int(os.environ.get("CEP_BENCH_RESIL_K", "64"))
+        n_batches = 4
+        batch_records = int(os.environ.get("CEP_BENCH_RESIL_B", "512"))
+        cfg = EngineConfig(
+            max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+            max_walk=12,
+        )
+        rng = np.random.default_rng(5)
+
+        def mk_batch(b, spike=0.005):
+            n = batch_records
+            keys = rng.integers(0, K, size=n)
+            prices = rng.integers(90, 131, size=n)
+            vols = np.where(
+                rng.random(n) < spike, 1100, rng.integers(700, 1000, size=n)
+            )
+            return [
+                Record(
+                    int(keys[i]),
+                    {"price": int(prices[i]), "volume": int(vols[i])},
+                    b * n + i,
+                )
+                for i in range(n)
+            ]
+
+        sup = Supervisor(
+            stock_demo.stock_pattern(), K, cfg, epoch=0,
+            checkpoint_path=os.path.join(workdir, "r.ckpt"),
+            journal_path=os.path.join(workdir, "r.jrnl"),
+            checkpoint_every=10**6,
+        )
+        for b in range(n_batches):
+            sup.process(mk_batch(b))
+        t0 = time.perf_counter()
+        sup.checkpoint()
+        out["checkpoint_s"] = round(time.perf_counter() - t0, 3)
+        for b in range(n_batches, 2 * n_batches):
+            sup.process(mk_batch(b))
+        t0 = time.perf_counter()
+        sup._recover()  # restore + replay the n_batches journal tail
+        out["recover_s"] = round(time.perf_counter() - t0, 3)
+
+        tiny = EngineConfig(
+            max_runs=8, slab_entries=32, slab_preds=4, dewey_depth=12,
+            max_walk=12,
+        )
+        esc = Supervisor(
+            stock_demo.stock_pattern(), K, tiny, epoch=0,
+            checkpoint_path=os.path.join(workdir, "e.ckpt"),
+            checkpoint_every=10**6,
+            auto_escalate=EscalationPolicy(max_config=cfg),
+        )
+        # Match-dense trace (20% begin spikes): run counts overflow
+        # max_runs=8 within a few batches.
+        esc.process(mk_batch(100, spike=0.2))
+        b = 101
+        t0 = time.perf_counter()
+        while esc.escalations == 0 and b < 120:
+            t0 = time.perf_counter()
+            esc.process(mk_batch(b, spike=0.2))
+            b += 1
+        if esc.escalations:
+            out["escalate_s"] = round(time.perf_counter() - t0, 3)
+        log(
+            f"resilience (K={K}, {batch_records}-record batches): "
+            f"checkpoint {out.get('checkpoint_s')}s, recovery "
+            f"{out.get('recover_s')}s (restore + {n_batches}-batch "
+            f"replay), escalation {out.get('escalate_s')}s (rollback + "
+            f"migrate + snapshot + re-process; escalations="
+            f"{esc.escalations})"
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return out
+
+
 def bench_oracle(n_events):
     rng = np.random.default_rng(42)
     prices = rng.integers(90, 131, size=n_events)
@@ -872,9 +974,14 @@ def main():
     # smoke runs stay fast (CEP_BENCH_EXTRAS=0 skips them entirely).  Each
     # extra is skipped once the wall budget is spent — compiles through the
     # device tunnel are slow and the headline JSON must always be printed.
+    resilience = {}
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
         budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "1200"))
         extras = [
+            (
+                "resilience",
+                lambda: resilience.update(bench_resilience()),
+            ),
             (
                 "processor",
                 # 128 events/lane/batch: this environment's device_get
@@ -976,6 +1083,10 @@ def main():
                 "lossfree_evps": round(lf_evps, 1),
                 "lossfree_counters_zero": bool(lf_zero),
                 "lossfree_oracle_parity": bool(lf_parity),
+                # Supervisor fault-path latencies (bench_resilience; None
+                # when extras are skipped) — ISSUE 2 asks later PRs to
+                # track recovery/escalation cost.
+                "resilience": resilience or None,
             }
         ),
         flush=True,

@@ -51,6 +51,7 @@ from kafkastreams_cep_tpu.engine.stencil import StencilMatcher
 from kafkastreams_cep_tpu.parallel import BatchMatcher, ShardedMatcher, key_mesh
 from kafkastreams_cep_tpu.runtime import (
     CEPProcessor,
+    InputRejected,
     Record,
     restore_processor,
     save_checkpoint,
@@ -85,6 +86,7 @@ __all__ = [
     "ShardedMatcher",
     "key_mesh",
     "CEPProcessor",
+    "InputRejected",
     "Record",
     "save_checkpoint",
     "restore_processor",

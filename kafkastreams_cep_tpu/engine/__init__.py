@@ -11,8 +11,11 @@ from kafkastreams_cep_tpu.engine.matcher import (
     TPUMatcher,
 )
 from kafkastreams_cep_tpu.engine.sizing import (
+    EscalationPolicy,
     ProbeReport,
     autosize,
+    capacity_counters,
+    escalate,
     probe,
     suggest,
 )
@@ -26,6 +29,7 @@ __all__ = [
     "ArrayStates",
     "EngineConfig",
     "EngineState",
+    "EscalationPolicy",
     "EventBatch",
     "MatcherSession",
     "ProbeReport",
@@ -35,6 +39,8 @@ __all__ = [
     "StepOutput",
     "TPUMatcher",
     "autosize",
+    "capacity_counters",
+    "escalate",
     "probe",
     "suggest",
 ]

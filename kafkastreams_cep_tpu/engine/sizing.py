@@ -18,6 +18,13 @@ rest.
                is excluded — with every capacity counter zero it marks
                reference-NPE trace states, a pattern property the
                reference would crash on, not a sizing defect).
+``escalate`` — the *online* analog of one autosize growth step: given
+               the capacity counters a live batch tripped, the next
+               strictly-wider config under an :class:`EscalationPolicy`
+               (growth factor, per-dim ceiling).  The supervisor pairs
+               it with live-state migration (``runtime/migrate.py``) so
+               a production overflow becomes a transparent capacity
+               escalation instead of a loss warning.
 """
 
 from __future__ import annotations
@@ -176,6 +183,75 @@ def suggest_hot_entries(slab_entries: int, max_alive_runs: int) -> int:
 def capacity_counters(counters: Dict[str, int]) -> Dict[str, int]:
     """The capacity-relevant subset of an engine counters dict."""
     return {k: counters[k] for k in _COUNTER_KNOB if k in counters}
+
+
+class EscalationPolicy(NamedTuple):
+    """How a live supervisor grows capacity when a batch trips a loss
+    counter (``Supervisor(auto_escalate=...)``).
+
+    ``growth``      — multiplier applied to each tripped dimension (shape
+                      dims re-round to the TPU sublane tile of 8).
+    ``hysteresis``  — consecutive tripping batches required before an
+                      escalation actually fires.  1 (default) escalates
+                      on the first trip, which is the only setting under
+                      which *nothing is ever lost* (the tripping batch is
+                      rolled back and re-processed wide); >1 tolerates
+                      transient spikes at the cost of warned-not-recovered
+                      loss on the tolerated batches — the classic
+                      stability-vs-loss hysteresis tradeoff, made
+                      explicit.
+    ``max_config``  — per-dimension ceiling; a dimension at its ceiling
+                      stops growing (None = unbounded).  When *every*
+                      tripped dimension is at its ceiling, escalation is
+                      exhausted and the supervisor degrades to the
+                      warn-and-count behavior.
+    ``max_rounds``  — growth rounds attempted per batch (a batch whose
+                      re-run still trips grows again, up to this bound).
+    """
+
+    growth: float = 2.0
+    hysteresis: int = 1
+    max_config: Optional[EngineConfig] = None
+    max_rounds: int = 4
+
+
+def escalate(
+    config: EngineConfig,
+    tripped: Dict[str, int],
+    policy: EscalationPolicy = EscalationPolicy(),
+) -> Optional[EngineConfig]:
+    """The next strictly-wider config for the counters in ``tripped``
+    (a counter-name -> positive-delta dict; names map to dims via the
+    same ``_COUNTER_KNOB`` table autosize uses).  Returns None when every
+    tripped dimension is already at its ceiling — escalation exhausted.
+    """
+    grown = {}
+    for counter, delta in tripped.items():
+        knob = _COUNTER_KNOB.get(counter)
+        if knob is None or not delta:
+            continue
+        cur = getattr(config, knob)
+        new = int(math.ceil(cur * policy.growth))
+        if knob != "max_walk":  # walk bound is exact work, not storage
+            new = _round8(new)
+        if policy.max_config is not None:
+            new = min(new, getattr(policy.max_config, knob))
+        if new > cur:
+            grown[knob] = new
+    if not grown:
+        return None
+    new_cfg = dataclasses.replace(config, **grown)
+    # Keep the hot-tier split valid (a perf knob — ops/slab.py proves
+    # drops identical at any E_hot, so deriving it fresh is safe) and
+    # sized for the grown run count.
+    if new_cfg.slab_hot_entries:
+        new_cfg = dataclasses.replace(
+            new_cfg,
+            slab_hot_entries=suggest_hot_entries(
+                new_cfg.slab_entries, new_cfg.max_runs // 2
+            ),
+        )
+    return new_cfg
 
 
 def autosize(

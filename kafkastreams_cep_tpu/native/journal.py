@@ -31,6 +31,7 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from kafkastreams_cep_tpu import native as _native
+from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("native.journal")
@@ -54,6 +55,9 @@ class Journal:
 
     def append(self, payload: bytes) -> None:
         payload = bytes(payload)
+        # Fault site: an append that fails before anything reaches the file
+        # (EROFS, ENOSPC at open) — see utils/failpoints.py.
+        _failpoint("journal.append")
         # Remember the last good boundary: a failed append may leave a torn
         # frame that would make every LATER (successful) frame unreachable
         # on replay — roll back to this size before reporting the failure.
@@ -63,6 +67,11 @@ class Journal:
             size0 = 0
         try:
             self._append(payload)
+            # Fault site at the durability barrier: the frame bytes reached
+            # the OS but the fsync (or the write itself, native path) is
+            # reported failed — the except clause below rolls the frame
+            # back so the on-disk journal stays a clean frame prefix.
+            _failpoint("journal.fsync")
         except Exception:
             self._rollback(size0)
             raise

@@ -16,12 +16,20 @@ responsibilities are host-side Python around the batched device matcher:
   the reference inherits from Kafka Streams (SURVEY §5).
 """
 
-from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
+from kafkastreams_cep_tpu.runtime.processor import (
+    CEPProcessor,
+    InputRejected,
+    Record,
+)
 from kafkastreams_cep_tpu.runtime.bank import CEPBank
 from kafkastreams_cep_tpu.runtime.checkpoint import (
     restore_processor,
     save_checkpoint,
     load_checkpoint,
+)
+from kafkastreams_cep_tpu.runtime.migrate import (
+    migrate_processor,
+    widen_state,
 )
 from kafkastreams_cep_tpu.runtime.supervisor import (
     HealthReport,
@@ -33,10 +41,13 @@ __all__ = [
     "CEPBank",
     "CEPProcessor",
     "HealthReport",
+    "InputRejected",
     "Record",
     "Supervisor",
     "check_health",
+    "migrate_processor",
     "save_checkpoint",
     "load_checkpoint",
     "restore_processor",
+    "widen_state",
 ]

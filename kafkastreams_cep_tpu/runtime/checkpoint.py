@@ -32,6 +32,7 @@ import numpy as np
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EngineState
 from kafkastreams_cep_tpu.runtime.processor import CEPProcessor
 
+from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("runtime.checkpoint")
@@ -76,7 +77,17 @@ def _unflatten_state(template: EngineState, arrays: Dict[str, np.ndarray]) -> En
                 f"checkpoint array {name!r} has shape {arr.shape}, "
                 f"engine expects {leaf.shape} (EngineConfig mismatch?)"
             )
-        leaves.append(arr.astype(leaf.dtype))
+        # No silent reinterpretation — the array-level twin of the header
+        # ``state_dtypes`` rule: agg stores float32 fold states as int32
+        # bit patterns, so a cast here could flip bits-as-values without
+        # any shape mismatch to catch it.
+        if np.dtype(arr.dtype) != np.dtype(leaf.dtype):
+            raise ValueError(
+                f"checkpoint array {name!r} has dtype {arr.dtype}, engine "
+                f"expects {np.dtype(leaf.dtype)} — refusing the silent "
+                "cast (dtype changes are not translatable)"
+            )
+        leaves.append(arr)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -87,6 +98,7 @@ def save_checkpoint(
 
     ``extra`` rides along in the header for the caller's own bookkeeping
     (e.g. the supervisor's journal sequence number)."""
+    _failpoint("checkpoint.save")
     if getattr(processor, "_pending", None) is not None:
         raise ValueError(
             "pipelined processor holds an undecoded batch; call flush() "

@@ -1,0 +1,283 @@
+"""Live-state migration onto a strictly-wider ``EngineConfig``.
+
+The reference never needs this: its run queue, shared buffer, and Dewey
+versions are heap-backed and unbounded (``NFA.java:75``,
+``CEPProcessor.java:144-149``).  The array engine's fixed shapes make
+capacity the design's own failure mode — overflow is counted and dropped
+(``ops/slab.py``), never silent, but the dropped branches are gone.  This
+module is the escape hatch: widen every state array of a *live* processor
+so the supervisor can escalate capacity mid-stream instead of warning
+about loss (``runtime/supervisor.py`` ``auto_escalate``).
+
+Why widening is a pure embedding (the proof burden, per dimension)
+-------------------------------------------------------------------
+A migration must guarantee: stepping the widened state under the wide
+engine produces, for as long as the *narrow* engine would not have hit a
+capacity limit, bit-identical run queues, slab contents, Dewey versions,
+fold state, match emissions, and capacity counters — and past the point
+the narrow engine would drop, the wide engine simply retains what the
+narrow one lost.  Dimension by dimension:
+
+* **R -> R' (run queue).**  Queue compaction (``engine/matcher.py
+  finish``) always leaves live runs in a contiguous prefix in queue
+  order, dead slots carrying the compaction fill values.  Appending dead
+  slots (the same fill values) preserves the prefix and its order; dead
+  slots are fully masked in the chain (``alive`` gates every predicate,
+  put, walk, and candidate), so they contribute nothing until a
+  compaction writes a live run into them — exactly when the narrow queue
+  would have counted a ``run_drops``.
+* **E -> E' (slab entries).**  Entries are keyed by ``(stage, off)`` —
+  unique across the slab — and every lookup is a full-slab masked match,
+  so results are placement-independent; allocation takes the *first*
+  free slot (``argmax``), and appended free slots sit at the end, so
+  allocation order is unchanged until the narrow slab would have been
+  full (a ``slab_full_drops``).  Two-tier layouts add demotion, but the
+  victim choice reads only occupied-hot rows (appended slots are free
+  overflow rows) and the overflow destination is again first-free —
+  unchanged until the narrow overflow tier would have filled.  Refcounts,
+  npreds, and the free list ride along untouched.
+* **MP -> MP' (predecessor lists).**  Pointers append at ``npreds`` and
+  walks take the first version-compatible pointer in insertion order;
+  padding null pointers (``pstage == -1``) past ``npreds`` is exactly the
+  representation an MP'-wide engine would have built.
+* **D -> D' (Dewey width).**  Versions are left-aligned digit vectors
+  with an explicit length; every Dewey op masks by length and slots at
+  index >= vlen are zero by construction (``ops/dewey_ops.py``), so a
+  zero-extended tail is the same version in a wider vector, and
+  ``is_compatible``/``add_run``/``add_stage`` answer identically.
+* **W, walker_budget (walk/compute bounds).**  Not state-shaped; growing
+  them needs no array change (they bound per-step compute, and a longer
+  bound only extends walks the narrow engine would have truncated into a
+  ``slab_trunc``).
+* **Counters.**  Copied verbatim — migration never forgives past loss;
+  the supervisor's escalation protocol instead *rolls back* to the last
+  pre-loss state and re-processes, which is what makes "finish with all
+  loss counters zero" achievable.
+
+The hot-tier split (``slab_hot_entries``) is a perf knob with no capacity
+semantics (drops are bit-identical at any E_hot — ``ops/slab.py``
+"Two-tier layout"); migration may change it freely, which moves entries'
+*tier accounting* (``hot_hits``/``demotions`` telemetry) but never the
+match stream or any capacity counter.
+
+Embedding parity — each dim widened alone and combined, jnp and kernel
+walk paths — is property-tested in ``tests/test_migrate.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EngineState
+from kafkastreams_cep_tpu.ops.slab import SlabState
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.migrate")
+
+# Config fields that are array-shape dims (may only grow) vs semantic
+# switches (must not change under a live migration: they alter the match
+# stream, not capacity).
+_SHAPE_DIMS = (
+    "max_runs", "slab_entries", "slab_preds", "dewey_depth", "max_walk",
+)
+_SEMANTIC_FLAGS = (
+    "renorm_versions", "enforce_windows", "sequential_slab", "walker_budget",
+)
+
+
+def check_widens(old: EngineConfig, new: EngineConfig) -> None:
+    """Refuse a migration target that is not a pure widening of ``old``."""
+    for f in _SHAPE_DIMS:
+        o, n = getattr(old, f), getattr(new, f)
+        if n < o:
+            raise ValueError(
+                f"migration cannot shrink {f}: {o} -> {n} (state embedding "
+                "only exists into a strictly-wider config)"
+            )
+    for f in _SEMANTIC_FLAGS:
+        o, n = getattr(old, f), getattr(new, f)
+        if o != n:
+            raise ValueError(
+                f"migration cannot change {f} ({o} -> {n}): it alters match "
+                "semantics, not capacity — restart the processor instead"
+            )
+    if new == old:
+        raise ValueError("migration target equals the current config")
+
+
+def _pad(arr: np.ndarray, axis: int, new_size: int, fill) -> np.ndarray:
+    """Grow ``arr`` along ``axis`` (negative, from the end) to
+    ``new_size``, new slots holding ``fill``."""
+    ax = arr.ndim + axis
+    grow = new_size - arr.shape[ax]
+    if grow == 0:
+        return arr
+    shape = list(arr.shape)
+    shape[ax] = grow
+    pad = np.full(shape, fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=ax)
+
+
+def widen_state(
+    state: EngineState, old: EngineConfig, new: EngineConfig
+) -> EngineState:
+    """Embed ``state`` (host or device arrays, any leading batch axes)
+    into the shapes of ``new``.  Returns host numpy arrays; callers
+    re-place onto the device (``CEPProcessor.place``)."""
+    check_widens(old, new)
+    g = lambda x: np.asarray(x)  # device_get + concrete dtype
+    R2, E2, MP2, D2 = (
+        new.max_runs, new.slab_entries, new.slab_preds, new.dewey_depth,
+    )
+    # Run-queue axis: dead-slot fill values match the queue compaction's
+    # (matcher.py ``compact`` fill args) so the widened state is exactly
+    # what a wide compaction of the same live set would have produced.
+    ver = _pad(_pad(g(state.ver), -1, D2, 0), -2, R2, 0)
+    slab = state.slab
+    new_slab = SlabState(
+        stage=_pad(g(slab.stage), -1, E2, -1),
+        off=_pad(g(slab.off), -1, E2, -1),
+        refs=_pad(g(slab.refs), -1, E2, 0),
+        npreds=_pad(g(slab.npreds), -1, E2, 0),
+        pstage=_pad(_pad(g(slab.pstage), -1, MP2, -1), -2, E2, -1),
+        poff=_pad(_pad(g(slab.poff), -1, MP2, -1), -2, E2, -1),
+        pver=_pad(
+            _pad(_pad(g(slab.pver), -1, D2, 0), -2, MP2, 0), -3, E2, 0
+        ),
+        pvlen=_pad(_pad(g(slab.pvlen), -1, MP2, 0), -2, E2, 0),
+        full_drops=g(slab.full_drops),
+        pred_drops=g(slab.pred_drops),
+        missing=g(slab.missing),
+        trunc=g(slab.trunc),
+        collisions=g(slab.collisions),
+        hot_hits=g(slab.hot_hits),
+        hot_misses=g(slab.hot_misses),
+        overflow_walks=g(slab.overflow_walks),
+        demotions=g(slab.demotions),
+    )
+    return EngineState(
+        alive=_pad(g(state.alive), -1, R2, False),
+        id_pos=_pad(g(state.id_pos), -1, R2, -1),
+        eval_pos=_pad(g(state.eval_pos), -1, R2, 0),
+        ver=ver,
+        vlen=_pad(g(state.vlen), -1, R2, 0),
+        event_off=_pad(g(state.event_off), -1, R2, -1),
+        start_ts=_pad(g(state.start_ts), -1, R2, -1),
+        branching=_pad(g(state.branching), -1, R2, False),
+        agg=_pad(g(state.agg), -2, R2, 0),
+        slab=new_slab,
+        run_drops=g(state.run_drops),
+        ver_overflows=g(state.ver_overflows),
+    )
+
+
+def canonical_state(state: EngineState) -> EngineState:
+    """Project ``state`` onto its *observable* content: dead slots take
+    canonical fill values.
+
+    The engine never reads a dead run slot (``alive`` gates everything),
+    a free slab row (``stage == -1`` never matches a lookup), or a
+    pointer slot at index >= ``npreds`` (every pointer scan masks by it)
+    — but those slots physically hold whatever the last shift/delete left
+    behind, and the leftovers differ between the jnp and kernel walk
+    implementations and across a migration (padded null vs stale
+    residue).  Two states are behaviorally identical iff their canonical
+    projections are bit-equal; the migration parity and chaos-oracle
+    suites compare through this.
+    """
+    g = lambda x: np.asarray(x)
+    alive = g(state.alive)
+    slab = state.slab
+    stage = g(slab.stage)
+    npreds = g(slab.npreds)
+    live_e = stage >= 0
+    mp = slab.pstage.shape[-1]
+    live_p = live_e[..., None] & (
+        np.arange(mp, dtype=np.int32) < npreds[..., None]
+    )
+    d = lambda m, arr, fill: np.where(m, g(arr), fill)
+    dp = live_p[..., None]  # broadcast over the Dewey axis
+    return EngineState(
+        alive=alive,
+        id_pos=d(alive, state.id_pos, -1),
+        eval_pos=d(alive, state.eval_pos, 0),
+        ver=d(alive[..., None], state.ver, 0),
+        vlen=d(alive, state.vlen, 0),
+        event_off=d(alive, state.event_off, -1),
+        start_ts=d(alive, state.start_ts, -1),
+        branching=d(alive, state.branching, False),
+        agg=d(alive[..., None], state.agg, 0),
+        slab=slab._replace(
+            stage=stage,
+            off=d(live_e, slab.off, -1),
+            refs=d(live_e, slab.refs, 0),
+            npreds=d(live_e, npreds, 0),
+            pstage=d(live_p, slab.pstage, -1),
+            poff=d(live_p, slab.poff, -1),
+            pver=d(dp, slab.pver, 0),
+            pvlen=d(live_p, slab.pvlen, 0),
+        ),
+        run_drops=g(state.run_drops),
+        ver_overflows=g(state.ver_overflows),
+    )
+
+
+def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
+    """Rebuild a live :class:`CEPProcessor` on a strictly-wider config.
+
+    ``pattern`` is re-compiled fresh (the ``ComputationStageSerDe``
+    contract: code never migrates, only state); all host bookkeeping —
+    lane map, offsets, event mirror, metrics — carries over by reference
+    semantics identical to a checkpoint restore, but without touching
+    disk.  The processor must hold no undecoded pipelined batch (call
+    ``flush()`` first): a device output is shaped by the *old* config and
+    cannot survive the migration.
+    """
+    from kafkastreams_cep_tpu.runtime.processor import CEPProcessor
+
+    if getattr(proc, "_pending", None) is not None:
+        raise ValueError(
+            "pipelined processor holds an undecoded batch; call flush() "
+            "before migrating (device outputs are shaped by the old config)"
+        )
+    old_config = proc.batch.matcher.config
+    check_widens(old_config, new_config)
+    new_proc = CEPProcessor(
+        pattern,
+        proc.num_lanes,
+        new_config,
+        topic=proc.topic,
+        epoch=proc.epoch,
+        gc_events=proc.gc_events,
+        dedup=proc.dedup,
+        gc_interval=proc.gc_interval,
+        gc_events_interval=proc.gc_events_interval,
+        decode_budget=proc.decode_budget,
+        pipeline=proc.pipeline,
+        mesh=mesh if mesh is not None else proc.mesh,
+    )
+    if list(new_proc.batch.names) != list(proc.batch.names):
+        raise ValueError(
+            "pattern topology changed across migration: stages "
+            f"{new_proc.batch.names} vs live {proc.batch.names}"
+        )
+    new_proc.state = new_proc.place(
+        widen_state(proc.state, old_config, new_config)
+    )
+    new_proc._lane_of = dict(proc._lane_of)
+    new_proc._key_of = dict(proc._key_of)
+    new_proc._next_offset = proc._next_offset.copy()
+    new_proc._off_base = proc._off_base.copy()
+    new_proc._events = [dict(d) for d in proc._events]
+    new_proc._col_batches = list(proc._col_batches)
+    new_proc._value_proto = proc._value_proto
+    new_proc.metrics = proc.metrics  # continuity: one stream, one meter
+    logger.info(
+        "migrated processor %s -> %s",
+        {f: getattr(old_config, f) for f in _SHAPE_DIMS},
+        {f: getattr(new_config, f) for f in _SHAPE_DIMS},
+    )
+    return new_proc

@@ -36,6 +36,7 @@ from kafkastreams_cep_tpu.engine.matcher import (
 )
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
+from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 from kafkastreams_cep_tpu.utils.metrics import Metrics
 
 from kafkastreams_cep_tpu.utils.logging import get_logger
@@ -43,6 +44,19 @@ from kafkastreams_cep_tpu.utils.logging import get_logger
 logger = get_logger("runtime")
 
 _I32 = np.iinfo(np.int32)
+
+
+class InputRejected(ValueError):
+    """Deterministic input rejection by processor validation.
+
+    Raised *before* any lane bookkeeping or device state mutates (batch
+    validation is atomic), so the batch is bad, not the engine: a
+    restore-and-replay recovery cycle cannot help and must not run.  The
+    supervisor keys on this exact type — a plain ``ValueError`` out of a
+    device dispatch (JAX surfaces some device faults that way) still
+    triggers recovery.  Subclasses ``ValueError`` so pre-existing callers'
+    except clauses keep working.
+    """
 
 
 class Record(NamedTuple):
@@ -190,7 +204,7 @@ class CEPProcessor:
             return existing
         lane = len(self._lane_of)
         if lane >= self.num_lanes:
-            raise ValueError(
+            raise InputRejected(
                 f"more than num_lanes={self.num_lanes} distinct keys; "
                 f"size the processor for the key cardinality it serves"
             )
@@ -207,7 +221,7 @@ class CEPProcessor:
     def _rebased_ts(self, timestamp: int) -> int:
         rel = int(timestamp) - self.epoch
         if not (_I32.min <= rel <= _I32.max):
-            raise ValueError(
+            raise InputRejected(
                 f"timestamp {timestamp} is {rel} ms from the processor epoch "
                 f"{self.epoch}, outside int32 device time (~±24.8 days); "
                 "construct the processor with an epoch near your stream's "
@@ -251,7 +265,7 @@ class CEPProcessor:
             if lane is None:
                 lane = len(lane_sim)
                 if lane >= self.num_lanes:
-                    raise ValueError(
+                    raise InputRejected(
                         f"more than num_lanes={self.num_lanes} distinct "
                         "keys; size the processor for the key cardinality "
                         "it serves"
@@ -266,13 +280,13 @@ class CEPProcessor:
         for rank, rec in enumerate(records):
             leaves = jax.tree_util.tree_leaves(rec.value)
             if len(leaves) != len(dtypes):
-                raise ValueError(
+                raise InputRejected(
                     f"record {rank}: value structure differs from the "
                     "schema fixed by the first record"
                 )
             for leaf, dt in zip(leaves, dtypes):
                 if np.issubdtype(np.asarray(leaf).dtype, np.floating) and not np.issubdtype(dt, np.floating):
-                    raise ValueError(
+                    raise InputRejected(
                         f"record {rank}: float value {leaf!r} in a field the "
                         "schema (fixed by the first record) typed as int"
                     )
@@ -286,13 +300,13 @@ class CEPProcessor:
                     base_sim[lane] = off  # first record fixes the lane base
                 dev = off - int(base_sim[lane])
                 if dev < 0:
-                    raise ValueError(
+                    raise InputRejected(
                         f"record {rank}: offset {off} is below lane "
                         f"{lane}'s base {int(base_sim[lane])} (out-of-order "
                         "replay below the first seen offset needs dedup=True)"
                     )
                 if dev >= OFFSET_LIMIT:
-                    raise ValueError(
+                    raise InputRejected(
                         f"record {rank}: offset {off} is {dev} past lane "
                         f"{lane}'s base — per-lane log positions must stay "
                         f"below 2^24 (engine f32 pointer packing)"
@@ -406,7 +420,7 @@ class CEPProcessor:
         scalars."""
         keys_arr = np.asarray(keys)
         if keys_arr.ndim != 1:
-            raise ValueError(
+            raise InputRejected(
                 f"keys must be a 1-D column, got shape {keys_arr.shape}"
             )
         ts_arr = np.asarray(timestamps, dtype=np.int64)
@@ -415,7 +429,7 @@ class CEPProcessor:
         # pack_column dereferences n column elements by row, so a short
         # timestamps column would be an out-of-bounds read, not an error.
         if ts_arr.shape != (n,):
-            raise ValueError(
+            raise InputRejected(
                 f"timestamps shape {ts_arr.shape} != ({n},); pass exactly "
                 "one timestamp per record"
             )
@@ -438,19 +452,19 @@ class CEPProcessor:
             )
         dtypes, treedef = jax.tree_util.tree_flatten(self._value_proto)
         if treedef_in != treedef:
-            raise ValueError(
+            raise InputRejected(
                 "value columns structure differs from the schema fixed by "
                 "the first batch"
             )
         for l, dt in zip(leaves_in, dtypes):
             if l.shape != (n,):
-                raise ValueError(
+                raise InputRejected(
                     f"value column shape {l.shape} != ({n},)"
                 )
             if np.issubdtype(l.dtype, np.floating) and not np.issubdtype(
                 dt, np.floating
             ):
-                raise ValueError(
+                raise InputRejected(
                     "float column in a field the schema typed as int"
                 )
 
@@ -462,7 +476,7 @@ class CEPProcessor:
             uniq = [v.item() for v in vals[np.argsort(first)]]
         new = [k for k in uniq if k not in self._lane_of]
         if len(self._lane_of) + len(new) > K:
-            raise ValueError(
+            raise InputRejected(
                 f"more than num_lanes={K} distinct keys; size the "
                 "processor for the key cardinality it serves"
             )
@@ -486,7 +500,7 @@ class CEPProcessor:
 
         rel = ts_arr - self.epoch
         if rel.size and (rel.min() < _I32.min or rel.max() > _I32.max):
-            raise ValueError(
+            raise InputRejected(
                 "timestamps outside int32 device time relative to the "
                 f"processor epoch {self.epoch}"
             )
@@ -500,7 +514,7 @@ class CEPProcessor:
         start_dev = self._next_offset - self._off_base  # [K] first dev off
         dev_off = (start_dev[lanes_arr] + pos).astype(np.int64)
         if dev_off.size and dev_off.max() >= OFFSET_LIMIT:
-            raise ValueError(
+            raise InputRejected(
                 "per-lane log positions past 2^24 (engine f32 pointer "
                 "packing) — rotate the processor via checkpoint/restore"
             )
@@ -570,6 +584,12 @@ class CEPProcessor:
         return self._dispatch(events, rank_of, n)
 
     def _dispatch(self, events, rank_of, n_records):
+        # Fault-injection sites (utils/failpoints.py; no-ops unless a test
+        # armed them): ``device.dispatch`` fails before the scan — state
+        # untouched; ``device.result`` fails after ``self.state`` advanced
+        # but before the batch's matches reach the caller — the adversarial
+        # window the supervisor's restore-and-replay must cover.
+        _failpoint("device.dispatch")
         if self.mesh is not None:
             events = self.batch.shard_events(events)
 
@@ -583,6 +603,7 @@ class CEPProcessor:
                 # fresh dispatch — the wait lands in the next call's
                 # decode of THIS batch, overlapped with its device scan.
                 jax.block_until_ready(out.count)
+        _failpoint("device.result")
         gc_due = self.gc_events and (
             (self.metrics.batches + 1) % self.gc_events_interval == 0
         )

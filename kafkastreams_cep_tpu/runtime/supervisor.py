@@ -45,11 +45,19 @@ from typing import Hashable, List, Optional, Sequence as Seq, Tuple
 
 import numpy as np
 
+from kafkastreams_cep_tpu.engine import sizing
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig
+from kafkastreams_cep_tpu.engine.sizing import EscalationPolicy
 from kafkastreams_cep_tpu.native.journal import Journal
 from kafkastreams_cep_tpu.runtime import checkpoint as ckpt_mod
-from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
+from kafkastreams_cep_tpu.runtime import migrate as migrate_mod
+from kafkastreams_cep_tpu.runtime.processor import (
+    CEPProcessor,
+    InputRejected,
+    Record,
+)
 from kafkastreams_cep_tpu.utils.events import Sequence
+from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
@@ -116,7 +124,17 @@ class Supervisor:
       CRC-framed on-disk journal (``native/journal.py``, C++ write path) —
       then :meth:`Supervisor.resume` recovers from a full *process* crash:
       restore the snapshot, replay the journal's intact prefix, continue.
-      ``journal_sync=True`` fsyncs per batch (machine-crash durable).
+      ``journal_sync=True`` fsyncs per batch (machine-crash durable);
+    * with ``auto_escalate`` set (``True`` for the default
+      :class:`~kafkastreams_cep_tpu.engine.sizing.EscalationPolicy`, or a
+      policy instance), a batch that trips a capacity-loss counter is
+      *rolled back* (checkpoint restore + journal replay), the live state
+      is migrated onto a strictly-wider config (``runtime/migrate.py`` —
+      a pure embedding, so nothing already matched changes), and the
+      batch re-processes at the new width — its dropped branches are
+      recovered, not warned about.  Escalations are counted in
+      ``escalations``; a post-escalation snapshot pins the wide config so
+      later recoveries and resumes replay at the new width.
     """
 
     _instance_ids = itertools.count()
@@ -131,10 +149,17 @@ class Supervisor:
         max_retries: int = 1,
         journal_path: Optional[str] = None,
         journal_sync: bool = False,
+        auto_escalate=False,
         processor: Optional[CEPProcessor] = None,
         _resuming: bool = False,
         **proc_kwargs,
     ):
+        if auto_escalate is True:
+            self._policy: Optional[EscalationPolicy] = EscalationPolicy()
+        elif auto_escalate:
+            self._policy = auto_escalate
+        else:
+            self._policy = None
         self._pattern = pattern
         self._proc_kwargs = dict(proc_kwargs)
         # ``processor`` injection lets resume() hand over an
@@ -190,6 +215,16 @@ class Supervisor:
         self.checkpoints = 0
         self.checkpoint_failures = 0
         self.journal_failures = 0
+        self.escalations = 0
+        # Escalation bookkeeping: capacity counters are cumulative, so
+        # trips are detected on the per-batch DELTA against this snapshot
+        # (refreshed after every batch / recovery / migration).
+        self._counter_base: Optional[dict] = None
+        self._trip_streak = 0
+        # Matches flushed out of a pipelined processor by a checkpoint but
+        # not yet returned to the caller (drained at the end of process();
+        # survives a checkpoint-save failure so nothing is ever lost).
+        self._unclaimed: List[Tuple[Hashable, Sequence]] = []
         # After a failed append the on-disk journal is no longer a complete
         # history — appending later batches would leave a seq gap that a
         # resume would replay straight through into a wrong state.  Suspend
@@ -259,6 +294,12 @@ class Supervisor:
                 sup._batches_since_ckpt += 1
                 sup._seq = seq
                 replayed += len(batch)
+        # Pipelined replay leaves the last batch undecoded: drain it
+        # (suppressed — the crashed process already emitted it) so it
+        # cannot leak out of the first post-resume process() call.
+        sup.processor.flush()
+        if sup._policy is not None:
+            sup._counter_base = sup._capacity_counters()
         logger.info(
             "resumed from %s + %s: %d journaled records replayed "
             "(%d pre-snapshot frames skipped)",
@@ -268,10 +309,25 @@ class Supervisor:
 
     # -- checkpointing ------------------------------------------------------
 
-    def checkpoint(self) -> None:
-        """Snapshot now (atomic) and truncate the journals."""
+    def checkpoint(self) -> List[Tuple[Hashable, Sequence]]:
+        """Snapshot now (atomic) and truncate the journals.
+
+        A pipelined processor is flushed first — a snapshot cannot carry
+        an undecoded device batch (checkpoint.py refuses it), and before
+        this flush every periodic snapshot of a ``pipeline=True``
+        processor silently failed into ``checkpoint_failures``.  The
+        flushed matches are returned (empty for serial processors); if
+        the snapshot itself fails they are retained and the next
+        :meth:`process` call returns them instead — flushing is
+        observable emission and must never be dropped with the snapshot.
+        """
+        if self.processor.pipeline:
+            self._unclaimed.extend(self.processor.flush())
         tmp = self.checkpoint_path + ".tmp"
         ckpt_mod.save_checkpoint(self.processor, tmp, extra={"seq": self._seq})
+        # Fault site: the crash window between writing the tmp snapshot
+        # and atomically installing it (utils/failpoints.py).
+        _failpoint("checkpoint.rename")
         os.replace(tmp, self.checkpoint_path)
         self._has_checkpoint = True
         self._journal.clear()
@@ -280,6 +336,11 @@ class Supervisor:
             self._journal_suspended = False  # clean base re-established
         self._batches_since_ckpt = 0
         self.checkpoints += 1
+        return self._drain_unclaimed()
+
+    def _drain_unclaimed(self) -> List[Tuple[Hashable, Sequence]]:
+        out, self._unclaimed = self._unclaimed, []
+        return out
 
     # -- the supervised hot path -------------------------------------------
 
@@ -289,13 +350,22 @@ class Supervisor:
         records = list(records)
         for attempt in range(self.max_retries + 1):
             try:
+                # Captured per attempt (a recovery resets the pipeline):
+                # whether the batch before this one is still undecoded —
+                # escalation must then recompute it too, since its matches
+                # ride the lossy attempt's (discarded) return value.
+                had_pending = (
+                    getattr(self.processor, "_pending", None) is not None
+                )
                 matches = self.processor.process(records)
                 break
-            except ValueError:
+            except InputRejected:
                 # Deterministic input rejection (schema, lane overflow,
                 # timestamp range): the batch is bad, not the device —
                 # restore-and-replay cannot help and state was untouched
-                # (processor validation is atomic).
+                # (processor validation is atomic).  Only the typed
+                # exception short-circuits: JAX surfaces some real device
+                # faults as bare ValueError, and those must recover.
                 raise
             except Exception:
                 if attempt >= self.max_retries:
@@ -305,6 +375,8 @@ class Supervisor:
                     len(records),
                 )
                 self._recover()
+        if self._policy is not None:
+            matches = self._maybe_escalate(records, matches, had_pending)
         self._journal.append(records)
         self._seq += 1
         if self._disk_journal is not None:
@@ -338,24 +410,34 @@ class Supervisor:
                         self._seq,
                     )
         self._batches_since_ckpt += 1
-        if self._batches_since_ckpt >= self.checkpoint_every:
+        # A suspended journal means acknowledged batches are NOT in the
+        # crash history — don't wait out the cadence, close the window by
+        # snapshotting immediately (a successful snapshot contains the
+        # un-journaled batch and re-arms journaling).
+        force_ckpt = self._journal_suspended
+        if force_ckpt or self._batches_since_ckpt >= self.checkpoint_every:
             # A failed snapshot (disk full, ...) must not lose the batch's
             # matches: the journal still covers everything since the last
             # good snapshot, so log, count, and retry next batch.
             try:
-                self.checkpoint()
+                matches = matches + self.checkpoint()
             except Exception:
                 self.checkpoint_failures += 1
                 logger.exception("checkpoint failed; journal retained")
+        if self._unclaimed:
+            # A failed snapshot above still flushed the pipeline; those
+            # matches belong to the caller either way.
+            matches = matches + self._drain_unclaimed()
         return matches
 
-    def _recover(self) -> None:
+    def _restore_tail(self) -> int:
         """Restore the last checkpoint and replay the journal tail.
 
         Replay is deterministic, so the processor lands in exactly the
         state it had after the last successful batch; replayed matches are
         dropped (already emitted).  With no checkpoint yet, the journal is
         the full history and replay starts from a fresh processor.
+        Shared by failure recovery and escalation rollback.
         """
         if self._has_checkpoint:
             self.processor = ckpt_mod.restore_processor(
@@ -372,11 +454,157 @@ class Supervisor:
         for batch in self._journal:
             self.processor.process(batch)  # matches already emitted
             replayed += len(batch)
+        # Pipelined replay leaves the last batch undecoded; drain it here
+        # (suppressed — already emitted) or it would leak into the next
+        # real process() call as a duplicate emission.
+        self.processor.flush()
+        return replayed
+
+    def _recover(self) -> None:
+        replayed = self._restore_tail()
         self.recoveries += 1
+        # Counters reverted with the state; re-snapshot the escalation
+        # baseline BEFORE the retry re-runs the failing batch, or its
+        # delta would be measured against the pre-failure accumulation.
+        if self._policy is not None:
+            self._counter_base = self._capacity_counters()
         logger.info(
             "recovered: checkpoint=%s, %d journaled records replayed",
             self._has_checkpoint, replayed,
         )
+
+    # -- elastic capacity escalation ----------------------------------------
+
+    def _capacity_counters(self) -> dict:
+        return sizing.capacity_counters(self.processor.counters())
+
+    def _maybe_escalate(
+        self, records, matches, had_pending: bool = False
+    ) -> List[Tuple[Hashable, Sequence]]:
+        """Detect capacity loss in the batch just processed and recover it.
+
+        Loss counters are cumulative, so a trip is a positive DELTA over
+        the post-previous-batch snapshot.  On a trip (after ``hysteresis``
+        consecutive tripping batches): roll the processor back to the
+        pre-batch state (the drop already cost this batch branches, and
+        those branches exist only in the pre-batch world), migrate the
+        live state onto the next wider config, snapshot it (so later
+        recoveries and resumes replay at the new width), and re-process
+        the batch — returning the re-run's matches, which supersede the
+        lossy attempt's (never emitted).  Repeats up to
+        ``policy.max_rounds`` if the re-run still trips; degrades to the
+        historical warn-and-count behavior at the policy ceiling.
+        """
+        policy = self._policy
+        counters = self._capacity_counters()
+        base = self._counter_base
+        if base is None:
+            # First observation (fresh/restored processor): no delta yet.
+            base = {k: 0 for k in counters} if self._seq == 0 else counters
+        tripped = {
+            k: v - base.get(k, 0)
+            for k, v in counters.items()
+            if v - base.get(k, 0) > 0
+        }
+        if not tripped:
+            self._counter_base = counters
+            self._trip_streak = 0
+            return matches
+        self._trip_streak += 1
+        if self._trip_streak < policy.hysteresis:
+            logger.warning(
+                "capacity trip %s tolerated (%d/%d before escalation); "
+                "this batch's lost branches are NOT recovered",
+                tripped, self._trip_streak, policy.hysteresis,
+            )
+            self._counter_base = counters
+            return matches
+        # Serial mode: ``matches`` is the lossy attempt's output, fully
+        # superseded by the re-run.  Pipeline mode: the attempt's return
+        # can mix the PREVIOUS batch's clean matches with this batch's
+        # lossy ones (the gc cadence drains both), so splitting it is not
+        # reliable — instead, when the previous batch was still in flight
+        # (``had_pending``), it is popped from the journal tail and
+        # recomputed from the rollback point alongside the tripping batch;
+        # both re-runs are flushed so everything returns synchronously.
+        pipeline = self.processor.pipeline
+        kept: List[Tuple[Hashable, Sequence]] = []
+        rerun = [] if pipeline else matches
+        # (had_pending implies the previous batch is the journal tail: a
+        # checkpoint or escalation would have flushed the pipeline, and
+        # both clear the pending marker — the bool() is belt-and-braces.)
+        redo_prev = pipeline and had_pending and bool(self._journal)
+        rolled = False
+        for _round in range(policy.max_rounds):
+            cfg = self.processor.batch.matcher.config
+            new_cfg = sizing.escalate(cfg, tripped, policy)
+            if new_cfg is None:
+                logger.warning(
+                    "escalation exhausted at the policy ceiling (counters "
+                    "%s); degrading to warn-and-count", tripped,
+                )
+                self._counter_base = counters
+                return (kept + rerun) if rolled else matches
+            if redo_prev:
+                prev_batch = self._journal.pop()
+            # Roll back to the pre-batch state; a pending pipelined decode
+            # belongs to the lossy attempt and dies with the old processor.
+            self._restore_tail()
+            self.processor = migrate_mod.migrate_processor(
+                self._pattern, self.processor, new_cfg,
+                mesh=self._proc_kwargs.get("mesh"),
+            )
+            self.escalations += 1
+            logger.warning(
+                "capacity escalation #%d: %s after counters %s; "
+                "re-processing the %d-record batch at the new width",
+                self.escalations, {
+                    k: getattr(new_cfg, k)
+                    for k in ("max_runs", "slab_entries", "slab_preds",
+                              "dewey_depth", "max_walk")
+                }, tripped, len(records),
+            )
+            if redo_prev:
+                # The in-flight previous batch: its matches rode the
+                # discarded lossy return, so emit them from this re-run
+                # (a wider config never drops where the narrow one
+                # didn't, so this re-run is clean by construction).
+                kept = list(self.processor.process(prev_batch))
+                kept += self.processor.flush()
+                self._journal.append(prev_batch)
+                redo_prev = False
+            # Pin the wide config on disk before re-processing: a recovery
+            # or resume between here and the next periodic snapshot must
+            # replay at the new width, not the old one.
+            try:
+                self.checkpoint()
+            except Exception:
+                self.checkpoint_failures += 1
+                logger.exception(
+                    "post-escalation checkpoint failed; a recovery before "
+                    "the next good snapshot replays at the OLD width"
+                )
+            pre = self._capacity_counters()
+            rerun = self.processor.process(records)
+            if pipeline:
+                rerun = rerun + self.processor.flush()
+            rolled = True
+            counters = self._capacity_counters()
+            tripped = {
+                k: counters[k] - pre[k]
+                for k in counters
+                if counters[k] - pre[k] > 0
+            }
+            if not tripped:
+                break
+        else:
+            logger.warning(
+                "batch still trips %s after %d escalation rounds; "
+                "keeping the widest result", tripped, policy.max_rounds,
+            )
+        self._counter_base = counters
+        self._trip_streak = 0
+        return kept + rerun
 
     # -- diagnostics --------------------------------------------------------
 
@@ -389,4 +617,5 @@ class Supervisor:
         out["checkpoints"] = self.checkpoints
         out["checkpoint_failures"] = self.checkpoint_failures
         out["journal_failures"] = self.journal_failures
+        out["escalations"] = self.escalations
         return out

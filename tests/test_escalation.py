@@ -1,0 +1,216 @@
+"""Elastic capacity escalation (Supervisor auto_escalate + sizing.escalate).
+
+The contract under test (ISSUE 2 acceptance): on an adversarial trace
+that overflows the seed config, an auto-escalating supervisor finishes
+with **all loss counters zero** and a match stream **identical to a
+fresh run at the final (wide) config** — the tripped batch is rolled
+back to its pre-loss state, migrated wider, and re-processed, so the
+branches a fixed-shape engine would have dropped are recovered, not
+warned about.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu.engine import (
+    EngineConfig,
+    EscalationPolicy,
+    capacity_counters,
+    escalate,
+)
+from kafkastreams_cep_tpu.runtime import Record, Supervisor
+
+SEED_CFG = EngineConfig(
+    max_runs=4, slab_entries=16, slab_preds=2, dewey_depth=8, max_walk=8
+)
+CEILING = EngineConfig(
+    max_runs=64, slab_entries=128, slab_preds=16, dewey_depth=32, max_walk=32
+)
+
+
+def storm_batches(n_cycles=5):
+    """skip_till_any branch storm: run count and pointer lists grow
+    geometrically — overflows max_runs=4 within two cycles."""
+    values = [sc.A, sc.B] + [sc.C, sc.D] * n_cycles
+    return [
+        [Record("k", v, 1000 + i, offset=i)] for i, v in enumerate(values)
+    ]
+
+
+def canon_stream(matches):
+    return [(k, sc.canon(seq)) for k, seq in matches]
+
+
+# -- policy unit behavior ----------------------------------------------------
+
+
+def test_escalate_grows_tripped_dims_only():
+    pol = EscalationPolicy(max_config=CEILING)
+    out = escalate(SEED_CFG, {"run_drops": 3, "slab_pred_drops": 1}, pol)
+    assert out.max_runs == 8 and out.slab_preds == 8  # rounded to tile
+    assert out.slab_entries == SEED_CFG.slab_entries
+    assert out.dewey_depth == SEED_CFG.dewey_depth
+
+
+def test_escalate_respects_ceiling_and_exhausts():
+    pol = EscalationPolicy(max_config=SEED_CFG)  # ceiling == current
+    assert escalate(SEED_CFG, {"run_drops": 5}, pol) is None
+    pol2 = EscalationPolicy(
+        max_config=dataclasses.replace(SEED_CFG, max_runs=8)
+    )
+    out = escalate(SEED_CFG, {"run_drops": 5, "slab_trunc": 2}, pol2)
+    assert out.max_runs == 8  # clamped
+    assert out.max_walk == SEED_CFG.max_walk  # its ceiling: unchanged
+
+
+def test_escalate_growth_factor():
+    pol = EscalationPolicy(growth=4.0, max_config=CEILING)
+    out = escalate(SEED_CFG, {"run_drops": 1}, pol)
+    assert out.max_runs == 16
+
+
+# -- end-to-end: the acceptance criterion ------------------------------------
+
+
+def test_escalation_recovers_all_dropped_branches(tmp_path):
+    """The headline property: lossy seed config + auto_escalate ends with
+    zero loss counters and the exact match stream of a fresh wide run."""
+    batches = storm_batches(5)
+    sup = Supervisor(
+        sc.skip_till_any(), 1, SEED_CFG,
+        checkpoint_path=str(tmp_path / "esc.ckpt"),
+        journal_path=str(tmp_path / "esc.jrnl"),
+        checkpoint_every=3,
+        auto_escalate=EscalationPolicy(max_config=CEILING),
+        gc_interval=0,
+    )
+    got = []
+    for b in batches:
+        got += sup.process(b)
+    assert sup.escalations >= 1
+    final_counters = capacity_counters(sup.processor.counters())
+    assert not any(final_counters.values()), final_counters
+
+    final_cfg = sup.processor.batch.matcher.config
+    ref = Supervisor(
+        sc.skip_till_any(), 1, final_cfg,
+        checkpoint_path=str(tmp_path / "ref.ckpt"),
+        checkpoint_every=3, gc_interval=0,
+    )
+    want = []
+    for b in batches:
+        want += ref.process(b)
+    assert canon_stream(got) == canon_stream(want)
+    assert not any(capacity_counters(ref.processor.counters()).values())
+
+
+def test_escalation_pins_wide_config_for_resume(tmp_path):
+    """The post-escalation snapshot records the wide config, so a process
+    crash right after an escalation resumes at the new width (replaying
+    the old-width snapshot would re-drop the recovered branches)."""
+    batches = storm_batches(4)
+    ck, jr = str(tmp_path / "p.ckpt"), str(tmp_path / "p.jrnl")
+    sup = Supervisor(
+        sc.skip_till_any(), 1, SEED_CFG,
+        checkpoint_path=ck, journal_path=jr, checkpoint_every=100,
+        auto_escalate=EscalationPolicy(max_config=CEILING), gc_interval=0,
+    )
+    for b in batches:
+        sup.process(b)
+    assert sup.escalations >= 1
+    wide = sup.processor.batch.matcher.config
+    del sup  # crash
+    res = Supervisor.resume(
+        sc.skip_till_any(), 1, SEED_CFG, checkpoint_path=ck,
+        journal_path=jr,
+        auto_escalate=EscalationPolicy(max_config=CEILING), gc_interval=0,
+    )
+    assert res.processor.batch.matcher.config == wide
+    assert not any(capacity_counters(res.processor.counters()).values())
+
+
+def test_hysteresis_tolerates_trips_before_escalating(tmp_path):
+    """hysteresis=2: the first tripping batch is warned (loss stands),
+    the second consecutive trip escalates."""
+    batches = storm_batches(5)
+    sup = Supervisor(
+        sc.skip_till_any(), 1, SEED_CFG,
+        checkpoint_path=str(tmp_path / "h.ckpt"), checkpoint_every=100,
+        auto_escalate=EscalationPolicy(max_config=CEILING, hysteresis=2),
+        gc_interval=0,
+    )
+    trips_seen = 0
+    for b in batches:
+        before = sup.escalations
+        sup.process(b)
+        if sup._trip_streak == 1 and sup.escalations == before:
+            trips_seen += 1  # a tolerated first trip
+    assert sup.escalations >= 1  # eventually escalated
+    assert trips_seen >= 1  # but at least one trip was tolerated first
+
+
+def test_exhausted_escalation_degrades_to_warning(tmp_path):
+    """At the policy ceiling the supervisor keeps the historical behavior:
+    count, warn via health, stay alive."""
+    sup = Supervisor(
+        sc.skip_till_any(), 1, SEED_CFG,
+        checkpoint_path=str(tmp_path / "x.ckpt"), checkpoint_every=100,
+        auto_escalate=EscalationPolicy(max_config=SEED_CFG),  # no headroom
+        gc_interval=0,
+    )
+    for b in storm_batches(4):
+        sup.process(b)
+    assert sup.escalations == 0
+    assert sup.processor.counters()["run_drops"] > 0
+    report = sup.health()
+    assert report.healthy and report.warnings  # lossy, not corrupt
+    # Still live: a fresh trace still matches.
+    out = []
+    for i, v in enumerate([sc.A, sc.B, sc.C, sc.D]):
+        out += sup.process([Record("k", v, 9000 + i, offset=100 + i)])
+    assert len(out) >= 1
+
+
+def test_escalation_in_pipeline_mode_loses_no_matches(tmp_path):
+    """Pipeline mode: the lossy batch's rollback must preserve the
+    previous batch's (clean, already-decoded) matches and return the
+    recovered batch's matches synchronously via a flush."""
+    batches = storm_batches(5)
+    sup = Supervisor(
+        sc.skip_till_any(), 1, SEED_CFG,
+        checkpoint_path=str(tmp_path / "pl.ckpt"), checkpoint_every=100,
+        auto_escalate=EscalationPolicy(max_config=CEILING),
+        pipeline=True, gc_interval=0,
+    )
+    got = []
+    for b in batches:
+        got += sup.process(b)
+    got += sup.checkpoint()  # drain the pipeline tail
+    assert sup.escalations >= 1
+    final_cfg = sup.processor.batch.matcher.config
+    ref = Supervisor(
+        sc.skip_till_any(), 1, final_cfg,
+        checkpoint_path=str(tmp_path / "plr.ckpt"), checkpoint_every=100,
+        gc_interval=0,
+    )
+    want = []
+    for b in batches:
+        want += ref.process(b)
+    assert sorted(map(repr, canon_stream(got))) == sorted(
+        map(repr, canon_stream(want))
+    )
+
+
+def test_escalation_counts_in_metrics(tmp_path):
+    sup = Supervisor(
+        sc.skip_till_any(), 1, SEED_CFG,
+        checkpoint_path=str(tmp_path / "m.ckpt"), checkpoint_every=100,
+        auto_escalate=EscalationPolicy(max_config=CEILING), gc_interval=0,
+    )
+    for b in storm_batches(4):
+        sup.process(b)
+    snap = sup.metrics_snapshot()
+    assert snap["escalations"] == sup.escalations >= 1

@@ -1,0 +1,180 @@
+"""Fault-injection harness (utils/failpoints.py): determinism of the
+registry itself, and each production site observed failing the way its
+real fault would."""
+
+import os
+import pickle
+
+import pytest
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu.native.journal import Journal
+from kafkastreams_cep_tpu.runtime import CEPProcessor, Record, Supervisor
+from kafkastreams_cep_tpu.utils import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fp.FAILPOINTS.clear()
+    yield
+    fp.FAILPOINTS.clear()
+
+
+# -- the registry ------------------------------------------------------------
+
+
+def test_disarmed_fire_is_noop():
+    fp.fire("device.dispatch")  # no session: nothing counted, nothing raised
+    assert fp.FAILPOINTS.hits("device.dispatch") == 0
+
+
+def test_armed_hits_fire_exactly_on_schedule():
+    fp.FAILPOINTS.arm("journal.append", hits=[1, 3])
+    fired = []
+    for i in range(5):
+        try:
+            fp.fire("journal.append")
+        except fp.InjectedIOError:
+            fired.append(i)
+    assert fired == [1, 3]
+    assert fp.FAILPOINTS.hits("journal.append") == 5
+
+
+def test_times_mode_fires_first_n():
+    fp.FAILPOINTS.arm("device.result", times=2)
+    fired = []
+    for i in range(4):
+        try:
+            fp.fire("device.result")
+        except fp.InjectedFault:
+            fired.append(i)
+    assert fired == [0, 1]
+
+
+def test_default_exception_family_by_site():
+    fp.FAILPOINTS.arm("device.dispatch", times=1)
+    fp.FAILPOINTS.arm("checkpoint.save", hits=[0])
+    with pytest.raises(fp.InjectedFault):
+        fp.fire("device.dispatch")
+    with pytest.raises(fp.InjectedIOError):
+        fp.fire("checkpoint.save")
+
+
+def test_session_clears_on_exit():
+    with fp.FAILPOINTS.session({"journal.append": [0]}):
+        with pytest.raises(fp.InjectedIOError):
+            fp.fire("journal.append")
+    fp.fire("journal.append")  # disarmed again
+    assert fp.FAILPOINTS.hits("journal.append") == 0
+
+
+def test_random_schedule_is_seed_deterministic():
+    a = fp.random_schedule(seed=7, horizon=40, rate=0.3)
+    b = fp.random_schedule(seed=7, horizon=40, rate=0.3)
+    c = fp.random_schedule(seed=8, horizon=40, rate=0.3)
+    assert a == b
+    assert a != c
+    assert any(a.values())  # at 0.3 x 40 hits something fires
+
+
+# -- sites observed through the real stack -----------------------------------
+
+
+def test_journal_append_site_rolls_back_cleanly(tmp_path):
+    """A failed append (either site) leaves the journal a clean frame
+    prefix — later appends and replay see no residue."""
+    path = str(tmp_path / "j.jrnl")
+    j = Journal(path)
+    j.append(b"one")
+    for site in ("journal.append", "journal.fsync"):
+        with fp.FAILPOINTS.session({site: [0]}):
+            with pytest.raises(OSError):
+                j.append(b"never-lands")
+        j.append(f"after-{site}".encode())
+    assert list(j.replay()) == [b"one", b"after-journal.append", b"after-journal.fsync"]
+
+
+def test_device_fault_sites_trigger_supervisor_recovery(tmp_path):
+    """Both dispatch-window faults recover: pre-scan (state untouched)
+    and post-scan (state advanced, matches undelivered)."""
+    for site in ("device.dispatch", "device.result"):
+        sup = Supervisor(
+            sc.strict3(), 1, sc.default_config(),
+            checkpoint_path=str(tmp_path / f"{site}.ckpt"),
+            checkpoint_every=100, gc_interval=0,
+        )
+        out = sup.process([Record("k", sc.A, 1, offset=0)])
+        with fp.FAILPOINTS.session({site: [0]}):
+            out += sup.process([Record("k", sc.B, 2, offset=1)])
+        out += sup.process([Record("k", sc.C, 3, offset=2)])
+        assert sup.recoveries == 1, site
+        assert len(out) == 1, site  # the match survived, exactly once
+
+
+def test_journal_failure_forces_immediate_checkpoint(tmp_path):
+    """An append failure suspends journaling; the supervisor closes the
+    durability window NOW by snapshotting instead of waiting out the
+    cadence, and journaling re-arms."""
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=str(tmp_path / "f.ckpt"),
+        journal_path=str(tmp_path / "f.jrnl"),
+        checkpoint_every=100, gc_interval=0,
+    )
+    with fp.FAILPOINTS.session({"journal.append": [0]}):
+        sup.process([Record("k", sc.A, 1, offset=0)])
+    assert sup.journal_failures == 1
+    assert sup.checkpoints == 1  # forced, not cadence (cadence is 100)
+    assert not sup._journal_suspended
+    sup.process([Record("k", sc.B, 2, offset=1)])
+    # The post-failure batch journals normally again.
+    frames = list(Journal(str(tmp_path / "f.jrnl")).replay())
+    assert len(frames) == 1
+    seq, batch = pickle.loads(frames[0])
+    assert [r.value for r in batch] == [sc.B]
+
+
+def test_checkpoint_save_and_rename_sites_are_failures_not_corruption(tmp_path):
+    """Snapshot faults at either site count as checkpoint_failures and
+    leave the previous snapshot installed."""
+    ck = str(tmp_path / "c.ckpt")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, checkpoint_every=1, gc_interval=0,
+    )
+    sup.process([Record("k", sc.A, 1, offset=0)])
+    assert sup.checkpoints == 1
+    good = open(ck, "rb").read()
+    for i, site in enumerate(("checkpoint.save", "checkpoint.rename")):
+        with fp.FAILPOINTS.session({site: [0]}):
+            sup.process([Record("k", sc.B, 2 + i, offset=1 + i)])
+        assert sup.checkpoint_failures == i + 1, site
+        assert open(ck, "rb").read() == good, site  # old snapshot intact
+    # Next batch snapshots fine.
+    sup.process([Record("k", sc.C, 9, offset=5)])
+    assert sup.checkpoints == 2
+    assert open(ck, "rb").read() != good
+
+
+def test_torn_tail_forgery_is_repaired_on_replay(tmp_path):
+    path = str(tmp_path / "t.jrnl")
+    j = Journal(path)
+    j.append(b"a")
+    j.append(b"b")
+    size_good = os.path.getsize(path)
+    fp.tear_journal_tail(path)
+    assert os.path.getsize(path) > size_good
+    assert list(j.replay()) == [b"a", b"b"]  # intact prefix; tail repaired
+    assert os.path.getsize(path) == size_good
+    j.append(b"c")  # appends continue at the clean boundary
+    assert list(j.replay()) == [b"a", b"b", b"c"]
+
+
+def test_corrupt_tail_forgery_is_repaired_on_replay(tmp_path):
+    path = str(tmp_path / "g.jrnl")
+    j = Journal(path)
+    j.append(b"a")
+    fp.corrupt_journal_tail(path, nbytes=32, seed=3)
+    assert list(j.replay()) == [b"a"]
+    j.append(b"b")
+    assert list(j.replay()) == [b"a", b"b"]

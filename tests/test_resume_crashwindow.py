@@ -1,0 +1,159 @@
+"""Supervisor.resume crash-window semantics (ISSUE 2 satellite).
+
+Three windows a process crash can land in, each with a distinct contract:
+
+* between ``save_checkpoint`` and journal ``truncate()`` — the journal
+  still holds frames the snapshot already contains; resume must skip
+  frames at/below the snapshot seq (no double replay);
+* a seq gap in the journal (a lost frame with later frames present) —
+  replay must stop at the last contiguous frame, never build a state
+  that skipped history;
+* a torn tail (crash mid-append) — replay repairs the file, and a
+  SECOND crash/resume cycle on the repaired journal stays consistent.
+"""
+
+import pickle
+
+import numpy as np
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu.native.journal import Journal
+from kafkastreams_cep_tpu.runtime import Record, Supervisor
+from kafkastreams_cep_tpu.runtime.migrate import canonical_state
+from kafkastreams_cep_tpu.utils import failpoints as fp
+
+
+def batches_for(values, t0=1000, off0=0):
+    return [
+        [Record("k", v, t0 + i, offset=off0 + i)]
+        for i, v in enumerate(values)
+    ]
+
+
+def reference_state(values):
+    """Device state after a clean, same-batching run."""
+    sup = Supervisor(sc.strict3(), 1, sc.default_config(), gc_interval=0)
+    out = []
+    for b in batches_for(values):
+        out += sup.process(b)
+    return sup.processor.state, out
+
+
+def assert_same_state(a, b):
+    import jax
+
+    ca, cb = canonical_state(a), canonical_state(b)
+    for i, (x, y) in enumerate(
+        zip(jax.tree_util.tree_leaves(ca), jax.tree_util.tree_leaves(cb))
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"leaf {i}"
+        )
+
+
+def test_crash_between_snapshot_and_truncate_skips_contained_frames(
+    tmp_path, monkeypatch
+):
+    """Checkpoint written, journal NOT yet truncated, crash: the journal
+    frames at/below the snapshot seq must be skipped on resume — the
+    no-double-replay half of the seq protocol."""
+    values = [sc.A, sc.B, sc.C, sc.A, sc.B]
+    ck, jr = str(tmp_path / "w1.ckpt"), str(tmp_path / "w1.jrnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, checkpoint_every=100,
+        gc_interval=0,
+    )
+    emitted = []
+    for b in batches_for(values[:3]):
+        emitted += sup.process(b)
+    assert len(emitted) == 1  # A,B,C completed
+    # Snapshot with the truncation suppressed = crash in the window.
+    monkeypatch.setattr(sup._disk_journal, "truncate", lambda: None)
+    sup.checkpoint()
+    assert len(list(Journal(jr).replay())) == 3  # frames survived the crash
+    for b in batches_for(values[3:], t0=1003, off0=3):
+        emitted += sup.process(b)
+    del sup  # crash
+
+    res = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, gc_interval=0,
+    )
+    # Were the pre-snapshot frames double-replayed, the dedup high-water
+    # mark would differ and the C re-seen post-resume would re-match.
+    ref_state, ref_out = reference_state(values)
+    assert_same_state(res.processor.state, ref_state)
+    more = res.process([Record("k", sc.C, 9000, offset=5)])
+    assert len(more) == 1  # A,B at offsets 3,4 + this C: exactly one match
+    assert len(emitted) == 1
+
+
+def test_seq_gap_stops_replay_at_last_contiguous_frame(tmp_path):
+    """A journal with frames 1,2,4 (frame 3 lost) must replay only 1,2:
+    replaying past the gap would build a state that never saw batch 3."""
+    values = [sc.A, sc.B, sc.C, sc.A]
+    ck, jr = str(tmp_path / "w2.ckpt"), str(tmp_path / "w2.jrnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, checkpoint_every=100,
+        gc_interval=0,
+    )
+    for b in batches_for(values):
+        sup.process(b)
+    del sup
+    # Forge the gap: rewrite the journal without frame seq==3.
+    j = Journal(jr)
+    frames = [pickle.loads(p) for p in j.replay()]
+    assert [s for s, _ in frames] == [1, 2, 3, 4]
+    j.truncate()
+    for seq, batch in frames:
+        if seq != 3:
+            j.append(pickle.dumps((seq, batch)))
+
+    res = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, gc_interval=0,
+    )
+    assert res._seq == 2  # stopped at the last contiguous frame
+    ref_state, _ = reference_state(values[:2])
+    assert_same_state(res.processor.state, ref_state)
+
+
+def test_torn_tail_repair_then_second_resume(tmp_path):
+    """Crash mid-append (torn tail): resume replays the intact prefix and
+    repairs the file; the in-flight batch was never acked, so the caller
+    re-submits it; a second crash/resume over the repaired journal lands
+    on the same state as a clean run."""
+    values = [sc.A, sc.B, sc.C]
+    ck, jr = str(tmp_path / "w3.ckpt"), str(tmp_path / "w3.jrnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, checkpoint_every=100,
+        gc_interval=0,
+    )
+    emitted = []
+    for b in batches_for(values[:2]):
+        emitted += sup.process(b)
+    fp.tear_journal_tail(jr)  # batch 3 died mid-write, process with it
+    del sup
+
+    res = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, gc_interval=0,
+    )
+    assert res._seq == 2  # only the intact frames
+    # Caller re-submits the unacknowledged batch; the match completes
+    # exactly once (it was never emitted pre-crash).
+    emitted += res.process([Record("k", sc.C, 1002, offset=2)])
+    assert len(emitted) == 1
+    del res  # second crash, now over the repaired + appended journal
+
+    res2 = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, gc_interval=0,
+    )
+    assert res2._seq == 3
+    ref_state, ref_out = reference_state(values)
+    assert_same_state(res2.processor.state, ref_state)
+    assert len(ref_out) == len(emitted) == 1

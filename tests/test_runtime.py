@@ -282,6 +282,34 @@ def test_checkpoint_refuses_fold_dtype_flip(tmp_path):
     restore_processor(fold_pattern(0), path)  # same dtype restores fine
 
 
+def test_checkpoint_refuses_array_dtype_mismatch(tmp_path):
+    """ISSUE 2 satellite: the array-level twin of the header dtype rule —
+    a checkpoint whose stored array dtype differs from the engine's is
+    refused instead of silently cast (astype could reinterpret typed-agg
+    bit patterns as values with no shape mismatch to catch it)."""
+    import io
+    import pickle
+
+    proc = CEPProcessor(sc.strict3(), 1, sc.default_config())
+    proc.process([Record("k", 0, 1)])
+    path = str(tmp_path / "ckpt.bin")
+    save_checkpoint(proc, path)
+    # Forge a dtype flip on one state array (agg int32 -> float32), the
+    # kind of corruption astype() used to paper over.
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    with np.load(io.BytesIO(blob["arrays"])) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["agg"] = arrays["agg"].astype(np.float32)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    blob["arrays"] = buf.getvalue()
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+    with pytest.raises(ValueError, match="dtype"):
+        restore_processor(sc.strict3(), path)
+
+
 def _run_batches(proc, batches):
     out = [proc.process(b) for b in batches]
     return out

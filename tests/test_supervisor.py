@@ -238,6 +238,84 @@ def test_health_detects_nan_fold_state():
     assert check_health(proc2).healthy
 
 
+def test_pipelined_supervisor_checkpoints_and_loses_nothing(tmp_path):
+    """ISSUE 2 satellite: periodic snapshots of a pipeline=True processor
+    used to be perpetual checkpoint_failures (save_checkpoint refuses a
+    pending undecoded batch).  The supervisor now flushes first and the
+    flushed matches still reach the caller."""
+    records = stock_records()
+    sup = Supervisor(
+        stock_demo.stock_pattern(), 1, stock_cfg(),
+        checkpoint_path=str(tmp_path / "p.ckpt"), checkpoint_every=2,
+        pipeline=True,
+    )
+    out = []
+    for i in range(0, len(records), 2):
+        out += sup.process(records[i:i + 2])
+    out += sup.checkpoint()  # drains the final in-flight batch
+    assert sup.checkpoint_failures == 0
+    assert sup.checkpoints >= 2
+    name_of = {i: e["name"] for i, e in enumerate(stock_demo.STOCK_EVENTS)}
+    lines = [stock_demo.format_match(seq, name_of) for _, seq in out]
+    assert lines == stock_demo.EXPECTED
+
+
+def test_pipelined_checkpoint_failure_keeps_flushed_matches(tmp_path, monkeypatch):
+    """If the snapshot fails AFTER the flush, the flushed matches are not
+    lost with it — they ride out on the same process() call."""
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=str(tmp_path / "pf.ckpt"), checkpoint_every=1,
+        pipeline=True,
+    )
+    from kafkastreams_cep_tpu.runtime import supervisor as sup_mod
+
+    def broken_save(processor, path, extra=None):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(sup_mod.ckpt_mod, "save_checkpoint", broken_save)
+    out = sup.process(
+        [Record("k", sc.A, 1), Record("k", sc.B, 2), Record("k", sc.C, 3)]
+    )
+    assert sup.checkpoint_failures == 1
+    assert len(out) == 1  # flushed match delivered despite the failed save
+
+
+def test_plain_valueerror_from_device_triggers_recovery(tmp_path):
+    """ISSUE 2 satellite: only the typed InputRejected short-circuits
+    recovery; a bare ValueError out of the dispatch (how JAX surfaces
+    some device faults) must restore-and-replay like any device loss."""
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=str(tmp_path / "v.ckpt"),
+    )
+    sup.process([Record("k", sc.A, 1)])
+    hook = FailOnce(sup.processor.batch.scan, fail_on_call=1)
+
+    def value_error_scan(state, events):
+        try:
+            return hook(state, events)
+        except RuntimeError:
+            raise ValueError("INTERNAL: device tunnel dropped")
+
+    sup.processor.batch.scan = value_error_scan
+    out = sup.process([Record("k", sc.B, 2), Record("k", sc.C, 3)])
+    assert sup.recoveries == 1
+    assert len(out) == 1  # the match completed across the recovery
+
+
+def test_input_rejected_is_a_valueerror():
+    """Compat: callers catching ValueError for validation errors keep
+    working; the supervisor distinguishes by the narrower type."""
+    from kafkastreams_cep_tpu.runtime import InputRejected
+
+    assert issubclass(InputRejected, ValueError)
+    proc = CEPProcessor(sc.strict3(), 1, sc.default_config())
+    proc.process([Record("k", sc.A, 1)])
+    with pytest.raises(InputRejected, match="num_lanes"):
+        proc.process([Record("other", sc.A, 2)])
+
+
 def test_supervisor_metrics_snapshot(tmp_path):
     sup = Supervisor(
         sc.strict3(), 1, sc.default_config(),
