@@ -25,8 +25,10 @@ oracle, ~2 min), ``CEP_BENCH_STENCIL_N`` / ``CEP_BENCH_STENCIL_INNER``
 (strict-SEQ stencil events and in-dispatch repeats), ``CEP_BENCH_EXTRAS``
 / ``CEP_BENCH_BUDGET_S`` / ``CEP_BENCH_{KLEENE,BANK,SHARD}_*`` (configs
 2-4), ``CEP_BENCH_HOT_ENTRIES`` (two-tier hot-window headline rerun,
-default 16, 0 skips), ``CEP_PLATFORM`` (force a JAX platform, e.g.
-``cpu``).
+default 16, 0 skips), ``CEP_BENCH_METRICS=1`` (run the headline config
+under the telemetry Reporter and print the per-phase p50/p99 block;
+``CEP_BENCH_METRICS_{K,T,BATCHES}`` size it), ``CEP_PLATFORM`` (force a
+JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -754,11 +756,27 @@ def bench_sharded_folds(K, T, reps):
     return K * T / best
 
 
+def phase_latency_block(snap):
+    """Per-phase p50/p99 milliseconds out of a ``metrics_snapshot()``'s
+    ``phases`` histograms — the headline JSON's tail-behavior block (the
+    BENCH trajectory previously captured throughput only)."""
+    out = {}
+    for name, h in sorted(snap.get("phases", {}).items()):
+        if h["count"]:
+            out[name] = {
+                "count": h["count"],
+                "p50_ms": round(h["p50"] * 1e3, 3),
+                "p99_ms": round(h["p99"] * 1e3, 3),
+            }
+    return out
+
+
 def bench_processor(K, T, n_batches):
     """Processor-level throughput at the headline config (SURVEY §2.2 PP
     row): columnar ingestion + pipelined dispatch + compacted decode.
     The gap to the engine-level rate is the host runtime's overhead —
-    round 4 paid pack + full-grid pull + sync serially on every batch."""
+    round 4 paid pack + full-grid pull + sync serially on every batch.
+    Returns ``(events/s, per-phase p50/p99 block)``."""
     from kafkastreams_cep_tpu.runtime import CEPProcessor
 
     cfg = EngineConfig(
@@ -800,7 +818,8 @@ def bench_processor(K, T, n_batches):
         n_matches += len(feed(b))
     n_matches += len(proc.flush())
     dt = time.perf_counter() - t0
-    snap = proc.metrics_snapshot()
+    snap = proc.metrics_snapshot(per_lane=False)
+    phases = phase_latency_block(snap)
     log(
         f"processor (pipelined columnar, {K} lanes x {T} ev x "
         f"{n_batches} batches): {n_batches * N / dt / 1e3:.0f}K ev/s "
@@ -811,7 +830,64 @@ def bench_processor(K, T, n_batches):
         "environment each batch pays a ~4s tunnel round-trip floor — "
         "bare engine rate on the same trace is ~1.6M ev/s)"
     )
-    return n_batches * N / dt
+    log(f"processor: per-phase latency {json.dumps(phases)}")
+    return n_batches * N / dt, phases
+
+
+def bench_metrics(K, T, n_batches, jsonl=None):
+    """``CEP_BENCH_METRICS=1``: the headline stock config run under the
+    full telemetry pipeline — JSONL trace sink + Reporter cadence +
+    Prometheus rendering — printing the per-phase p50/p99 block.  Kept as
+    a plain function over (K, T, n_batches) so the tier-1 smoke test
+    (tests/test_telemetry.py) can drive it at tiny shapes — the extra
+    cannot silently rot.  Returns ``(phase block, events written)``."""
+    import io
+
+    from kafkastreams_cep_tpu.runtime import CEPProcessor
+    from kafkastreams_cep_tpu.utils.telemetry import (
+        JsonlTraceSink,
+        Reporter,
+        render_prometheus,
+    )
+
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    buf = jsonl if jsonl is not None else io.StringIO()
+    sink = JsonlTraceSink(buf)
+    proc = CEPProcessor(
+        stock_demo.stock_pattern(), K, cfg, epoch=0, trace_sink=sink,
+    )
+    reporter = Reporter(
+        proc.metrics_snapshot, sink,
+        every_batches=max(n_batches // 2, 1),
+    )
+    rng = np.random.default_rng(31)
+    N = K * T
+    keys = np.tile(np.arange(K, dtype=np.int64), T)
+    for b in range(n_batches):
+        prices = rng.integers(90, 131, size=N).astype(np.int64)
+        volumes = rng.integers(600, 1101, size=N).astype(np.int64)
+        ts = np.int64(b) * N + np.arange(N, dtype=np.int64)
+        proc.process_columns(keys, {"price": prices, "volume": volumes}, ts)
+        reporter.tick()
+    snap = reporter.flush()
+    block = phase_latency_block(snap)
+    n_events = (
+        buf.getvalue().count("\n") if isinstance(buf, io.StringIO) else None
+    )
+    log(
+        f"metrics ({K} lanes x {T} ev x {n_batches} batches under the "
+        f"Reporter): {reporter.flushes} snapshot flushes, "
+        f"{n_events} JSONL events; per-phase latency {json.dumps(block)}"
+    )
+    prom = render_prometheus(snap)
+    log(
+        f"metrics: prometheus exposition {len(prom.splitlines())} lines "
+        f"(e.g. {prom.splitlines()[0]!r})"
+    )
+    return block, n_events
 
 
 def bench_resilience():
@@ -975,6 +1051,7 @@ def main():
     # extra is skipped once the wall budget is spent — compiles through the
     # device tunnel are slow and the headline JSON must always be printed.
     resilience = {}
+    proc_phases = {}
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
         budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "1200"))
         extras = [
@@ -991,10 +1068,12 @@ def main():
                 # must amortize a ~4s fixed round-trip cost; 256 would
                 # amortize further but two in-flight [K,T,R,W] outputs
                 # exceed HBM.
-                lambda: bench_processor(
-                    int(os.environ.get("CEP_BENCH_PROC_K", str(K))),
-                    int(os.environ.get("CEP_BENCH_PROC_T", "128")),
-                    int(os.environ.get("CEP_BENCH_PROC_BATCHES", "4")),
+                lambda: proc_phases.update(
+                    bench_processor(
+                        int(os.environ.get("CEP_BENCH_PROC_K", str(K))),
+                        int(os.environ.get("CEP_BENCH_PROC_T", "128")),
+                        int(os.environ.get("CEP_BENCH_PROC_BATCHES", "4")),
+                    )[1]
                 ),
             ),
             (
@@ -1033,6 +1112,18 @@ def main():
                 ),
             ),
         ]
+        if os.environ.get("CEP_BENCH_METRICS", "0") == "1":
+            # Telemetry-pipeline extra (tier-1 smoke-tested at tiny
+            # shapes): first so the wall budget can't starve it out when
+            # explicitly requested.
+            extras.insert(0, (
+                "metrics",
+                lambda: bench_metrics(
+                    int(os.environ.get("CEP_BENCH_METRICS_K", "256")),
+                    int(os.environ.get("CEP_BENCH_METRICS_T", "64")),
+                    int(os.environ.get("CEP_BENCH_METRICS_BATCHES", "4")),
+                ),
+            ))
         import gc
 
         for name, fn in extras:
@@ -1087,6 +1178,10 @@ def main():
                 # when extras are skipped) — ISSUE 2 asks later PRs to
                 # track recovery/escalation cost.
                 "resilience": resilience or None,
+                # Per-phase p50/p99 end-to-end latency from the processor
+                # extra's telemetry histograms (ISSUE 3) — tail behavior,
+                # not just throughput (None when extras are skipped).
+                "phase_latency": proc_phases or None,
             }
         ),
         flush=True,

@@ -57,6 +57,13 @@ from kafkastreams_cep_tpu.runtime import (
     save_checkpoint,
 )
 from kafkastreams_cep_tpu.utils.logging import configure_logging
+from kafkastreams_cep_tpu.utils.telemetry import (
+    InMemoryTraceSink,
+    JsonlTraceSink,
+    MetricsRegistry,
+    Reporter,
+    render_prometheus,
+)
 
 __version__ = "0.2.0"
 
@@ -91,4 +98,9 @@ __all__ = [
     "save_checkpoint",
     "restore_processor",
     "configure_logging",
+    "InMemoryTraceSink",
+    "JsonlTraceSink",
+    "MetricsRegistry",
+    "Reporter",
+    "render_prometheus",
 ]

@@ -284,6 +284,19 @@ def hot_counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
     )
 
 
+def per_lane_counter_arrays(state: "EngineState") -> Dict[str, Any]:
+    """Un-summed counter arrays (drop + hot), one host int64 array per
+    name, for per-lane attribution (telemetry pillar 3): a ``[K]``-batched
+    state yields ``[K]`` arrays — which lane is burning capacity — while a
+    single-lane state yields scalars.  One ``device_get`` for all of them.
+    """
+    names = COUNTER_NAMES + HOT_COUNTER_NAMES
+    vals = jax.device_get(counter_values(state) + hot_counter_values(state))
+    return {
+        n: np.asarray(v).astype(np.int64) for n, v in zip(names, vals)
+    }
+
+
 class StepPhases(NamedTuple):
     """The step's per-lane phase functions, exposed so batched callers can
     run the walk pass over the full lane batch (the fused Pallas kernel

@@ -350,3 +350,22 @@ class BatchMatcher:
             n: int(jnp.sum(v))
             for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
         }
+
+    def per_lane_counters(self, state: EngineState) -> Dict[str, list]:
+        """Per-lane (un-summed) drop + hot counters: ``{name: [K ints]}``
+        — which lane is burning capacity, beside the summed view."""
+        from kafkastreams_cep_tpu.engine.matcher import per_lane_counter_arrays
+
+        return {
+            n: v.reshape(-1).tolist()
+            for n, v in per_lane_counter_arrays(state).items()
+        }
+
+    def metrics_snapshot(self, state: EngineState) -> Dict[str, object]:
+        """Engine-level telemetry of ``state`` in one dict: summed drop and
+        hot-tier counters plus the per-lane breakdown."""
+        out: Dict[str, object] = {}
+        out.update(self.counters(state))
+        out.update(self.hot_counters(state))
+        out["per_lane"] = self.per_lane_counters(state)
+        return out

@@ -211,6 +211,27 @@ class ShardedMatcher:
         stats = self.stats(state)
         return {k: stats[k] for k in HOT_COUNTER_NAMES}
 
+    def per_lane_counters(self, state: EngineState) -> Dict[str, list]:
+        """Per-lane drop + hot counters gathered from every shard:
+        ``{name: [K ints]}`` with global lane indices (the lane axis is
+        sharded, so lane ``k`` lives on device ``k // (K/n)``) — which
+        lane, and therefore which shard, is burning capacity."""
+        from kafkastreams_cep_tpu.engine.matcher import per_lane_counter_arrays
+
+        return {
+            n: v.reshape(-1).tolist()
+            for n, v in per_lane_counter_arrays(state).items()
+        }
+
+    def metrics_snapshot(self, state: EngineState) -> Dict[str, object]:
+        """Mesh-global engine telemetry in one dict — the per-shard
+        registries merged: the summed view rides the one-``psum`` ``stats``
+        collective (each shard's counter block is its local registry; the
+        psum IS the merge), the per-lane breakdown a host gather."""
+        out: Dict[str, object] = dict(self.stats(state))
+        out["per_lane"] = self.per_lane_counters(state)
+        return out
+
     def sweep(self, state: EngineState) -> EngineState:
         """Slab mark-sweep over every shard (lane-elementwise — XLA keeps
         the existing sharding; no collectives)."""

@@ -154,6 +154,32 @@ class StackedBankMatcher:
             for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
         }
 
+    def per_query_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
+        """Per-pattern attribution: drop + hot counters summed over each
+        query's ``K``-lane block of the ``[Q*K]`` lane axis (lane layout is
+        query-major) — which bank member is burning capacity inside the
+        one fused dispatch."""
+        from kafkastreams_cep_tpu.engine.matcher import per_lane_counter_arrays
+
+        arrays = per_lane_counter_arrays(state)
+        return {
+            f"q{q}": {
+                n: int(v.reshape(self.Q, self.K)[q].sum())
+                for n, v in arrays.items()
+            }
+            for q in range(self.Q)
+        }
+
+    def metrics_snapshot(self, state: EngineState) -> Dict[str, object]:
+        """Bank-wide engine telemetry: the per-member registries merged
+        (summed drop + hot counters) beside the ``per_pattern`` breakdown
+        that attributes them to individual queries."""
+        out: Dict[str, object] = {}
+        out.update(self.counters(state))
+        out.update(self.hot_counters(state))
+        out["per_pattern"] = self.per_query_counters(state)
+        return out
+
 
 def choose_bank(
     patterns: Sequence,

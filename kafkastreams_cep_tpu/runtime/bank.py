@@ -19,6 +19,8 @@ from typing import Dict, Hashable, List, Optional, Sequence as Seq, Tuple
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig
 from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
 from kafkastreams_cep_tpu.utils.events import Sequence
+from kafkastreams_cep_tpu.utils.metrics import Metrics
+from kafkastreams_cep_tpu.utils.telemetry import merge_counter_dicts
 
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
@@ -42,12 +44,14 @@ class CEPBank:
         config: Optional[EngineConfig] = None,
         topic: str = "stream",
         epoch: Optional[int] = None,
+        trace_sink=None,
     ):
         if not patterns:
             raise ValueError("a bank needs at least one pattern")
         self.processors: Dict[str, CEPProcessor] = {
             name: CEPProcessor(
-                pattern, num_lanes, config, topic=topic, epoch=epoch
+                pattern, num_lanes, config, topic=topic, epoch=epoch,
+                trace_sink=trace_sink, name=name,
             )
             for name, pattern in patterns.items()
         }
@@ -65,3 +69,29 @@ class CEPBank:
 
     def counters(self) -> Dict[str, Dict[str, int]]:
         return {name: p.counters() for name, p in self.processors.items()}
+
+    def metrics_snapshot(self) -> Dict[str, object]:
+        """Bank-wide telemetry: the member registries *merged* (runtime
+        counters summed, per-phase latency histograms exactly aggregated —
+        the registry ``merge`` is associative, so this equals one registry
+        having observed every member's batches), engine drop + hot-tier
+        counters summed across members, and the un-merged ``per_pattern``
+        breakdown that attributes the totals to individual queries."""
+        procs = list(self.processors.values())
+        reg = procs[0].metrics.registry
+        for p in procs[1:]:
+            reg = reg.merge(p.metrics.registry)
+        engine = merge_counter_dicts(
+            [{**p.counters(), **p.hot_counters()} for p in procs]
+        )
+        snap = Metrics(registry=reg).snapshot(engine)
+        snap["per_pattern"] = {
+            name: {
+                **p.counters(),
+                **p.hot_counters(),
+                "records_in": p.metrics.records_in,
+                "matches_out": p.metrics.matches_out,
+            }
+            for name, p in self.processors.items()
+        }
+        return snap

@@ -22,6 +22,8 @@ matches on absolute time and your timestamps are small.
 
 from __future__ import annotations
 
+import contextlib
+import time
 from typing import Any, Dict, Hashable, List, NamedTuple, Optional, Sequence as Seq, Tuple
 
 import jax
@@ -37,7 +39,8 @@ from kafkastreams_cep_tpu.engine.matcher import (
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
-from kafkastreams_cep_tpu.utils.metrics import Metrics
+from kafkastreams_cep_tpu.utils.metrics import Metrics, device_memory_stats
+from kafkastreams_cep_tpu.utils.telemetry import TraceSink, maybe_span
 
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
@@ -130,6 +133,8 @@ class CEPProcessor:
         decode_budget: int = 131072,
         pipeline: bool = False,
         mesh=None,
+        trace_sink: Optional[TraceSink] = None,
+        name: Optional[str] = None,
     ):
         # ``mesh``: a ``jax.sharding.Mesh`` shards the lane axis over the
         # devices (state-follows-partition, ``CEPProcessor.java:117-134`` —
@@ -195,6 +200,18 @@ class CEPProcessor:
         self._col_batches: List[tuple] = []
         self._value_proto = None
         self.metrics = Metrics()
+        # Telemetry (utils/telemetry.py): an optional span sink — every
+        # process() call emits one "batch" span with nested phase spans
+        # (pack -> dispatch -> device -> decode -> gc); None costs one
+        # attribute check per phase.  ``name`` labels this processor in
+        # per-pattern attribution (bank members pass their query name).
+        self.trace = trace_sink
+        self.name = name or topic
+        self._batch_seq = 0
+        # Event-time watermark: the max record timestamp ingested (absolute
+        # ms), for the watermark / event-time-lag gauges in
+        # ``metrics_snapshot`` — the ``records-lag`` analog.
+        self._watermark: Optional[int] = None
 
     # -- key -> lane assignment (partition-assignment analog) ---------------
 
@@ -231,9 +248,35 @@ class CEPProcessor:
 
     # -- the per-batch hot path --------------------------------------------
 
+    @contextlib.contextmanager
+    def _phase(self, name: str):
+        """One batch phase: a nested trace span + the ``{name}_seconds``
+        accumulator + the ``phases[name]`` latency histogram, in one."""
+        with maybe_span(self.trace, f"phase.{name}"):
+            with self.metrics.timed(f"{name}_seconds"):
+                yield
+
     def process(self, records: Seq[Record]) -> List[Tuple[Hashable, Sequence]]:
         if not records:
             return []
+        self._batch_seq += 1
+        with maybe_span(
+            self.trace, "batch", path="records", batch=self._batch_seq,
+            records=len(records),
+        ) as sp:
+            with self._phase("pack"):
+                packed = self._pack_records(records)
+            if packed is None:
+                return []
+            events, rank_of, n_kept = packed
+            sp["lanes"] = len(self._lane_of)
+            matches = self._dispatch(events, rank_of, n_kept)
+            sp["matches"] = len(matches)
+            return matches
+
+    def _pack_records(self, records: Seq[Record]):
+        """Validate + lane-assign + pad one record batch to ``[K, T]``
+        device columns; None when every record was a replay duplicate."""
         K = self.num_lanes
         if self.epoch is None:
             self.epoch = int(records[0].timestamp)
@@ -339,8 +382,10 @@ class CEPProcessor:
         self.metrics.duplicates_dropped += dropped
         if dropped:
             logger.info("dropped %d replayed records (high-water mark)", dropped)
+        wm = max(int(rec.timestamp) for rec in records)
+        self._watermark = wm if self._watermark is None else max(self._watermark, wm)
         if all(off is None for off in offsets):
-            return []
+            return None
 
         # Lane-queue positions + columnar [K, T] packing via the native
         # ingest kernels (NumPy fallbacks inside, ``native/``).
@@ -397,7 +442,7 @@ class CEPProcessor:
             off=jnp.asarray(off),
             valid=jnp.asarray(valid),
         )
-        return self._dispatch(events, rank_of, len(records) - dropped)
+        return events, rank_of, len(records) - dropped
 
     def process_columns(
         self, keys, values, timestamps
@@ -418,6 +463,22 @@ class CEPProcessor:
         needs the per-record path.  Emitted Events carry values rebuilt
         from the packed columns (schema dtypes), not the caller's original
         scalars."""
+        self._batch_seq += 1
+        with maybe_span(
+            self.trace, "batch", path="columns", batch=self._batch_seq,
+        ) as sp:
+            with self._phase("pack"):
+                packed = self._pack_columns(keys, values, timestamps)
+            if packed is None:
+                return []
+            events, rank_of, n = packed
+            sp["records"] = n
+            sp["lanes"] = len(self._lane_of)
+            matches = self._dispatch(events, rank_of, n)
+            sp["matches"] = len(matches)
+            return matches
+
+    def _pack_columns(self, keys, values, timestamps):
         keys_arr = np.asarray(keys)
         if keys_arr.ndim != 1:
             raise InputRejected(
@@ -434,7 +495,7 @@ class CEPProcessor:
                 "one timestamp per record"
             )
         if n == 0:
-            return []
+            return None
         K = self.num_lanes
         if self.epoch is None:
             self.epoch = int(ts_arr[0])
@@ -504,6 +565,8 @@ class CEPProcessor:
                 "timestamps outside int32 device time relative to the "
                 f"processor epoch {self.epoch}"
             )
+        wm = int(ts_arr.max())
+        self._watermark = wm if self._watermark is None else max(self._watermark, wm)
 
         keep = np.ones(n, dtype=np.uint8)
         pos, qlen, max_len = native.queue_positions(lanes_arr, keep, K)
@@ -581,7 +644,7 @@ class CEPProcessor:
             off=jnp.asarray(off),
             valid=jnp.asarray(valid),
         )
-        return self._dispatch(events, rank_of, n)
+        return events, rank_of, n
 
     def _dispatch(self, events, rank_of, n_records):
         # Fault-injection sites (utils/failpoints.py; no-ops unless a test
@@ -593,10 +656,13 @@ class CEPProcessor:
         if self.mesh is not None:
             events = self.batch.shard_events(events)
 
-        with self.metrics.timed("device_seconds"):
+        with self._phase("dispatch"):
+            # Enqueue only: the scan (and any due sweep) dispatch async;
+            # the wait is attributed to the device phase below.
             self.state, out = self.batch.scan(self.state, events)
             if self.gc_interval and (self.metrics.batches + 1) % self.gc_interval == 0:
                 self.state = self.batch.sweep(self.state)
+        with self._phase("device"):
             if not self.pipeline:
                 # Serial mode: wait here so device_seconds is the real
                 # device wall time.  Pipelined mode never blocks on the
@@ -609,7 +675,7 @@ class CEPProcessor:
         )
         self.metrics.records_in += n_records
         self.metrics.batches += 1
-        with self.metrics.timed("decode_seconds"):
+        with self._phase("decode"):
             if self.pipeline:
                 prev, self._pending = self._pending, (out, rank_of)
                 matches = self._decode(*prev) if prev is not None else []
@@ -618,11 +684,11 @@ class CEPProcessor:
                     # still-pending decode references: drain first.
                     pend, self._pending = self._pending, None
                     matches += self._decode(*pend)
-                    self._gc_events()
             else:
                 matches = self._decode(out, rank_of)
-                if gc_due:
-                    self._gc_events()
+        if gc_due:
+            with self._phase("gc"):
+                self._gc_events()
         self.metrics.matches_out += len(matches)
         return matches
 
@@ -634,7 +700,7 @@ class CEPProcessor:
             return []
         out, rank_of = self._pending
         self._pending = None
-        with self.metrics.timed("decode_seconds"):
+        with self._phase("decode"):
             matches = self._decode(out, rank_of)
         self.metrics.matches_out += len(matches)
         return matches
@@ -789,6 +855,41 @@ class CEPProcessor:
         """Lane-summed overflow/drop counters (all zero in healthy runs)."""
         return self.batch.counters(self.state)
 
-    def metrics_snapshot(self) -> Dict[str, float]:
-        """Runtime metrics + engine counters in one flat dict."""
-        return self.metrics.snapshot(self.counters())
+    def hot_counters(self) -> Dict[str, int]:
+        """Two-tier residency telemetry of the live state (lane-summed;
+        all zero when ``slab_hot_entries == 0``)."""
+        return self.batch.hot_counters(self.state)
+
+    def metrics_snapshot(self, per_lane: bool = True) -> Dict[str, Any]:
+        """Runtime metrics + engine counters + attribution in one dict.
+
+        Flat lifetime counters keep their historical keys; added on top:
+        hot-tier counters (``slab_hot_hits``, ... — previously computed but
+        unreachable from the snapshot), per-phase latency histograms under
+        ``"phases"`` (count/sum/p50/p99 per batch phase), per-lane and
+        per-pattern engine-counter breakdowns, the event-time watermark and
+        lag gauges, and HBM byte gauges (``device_memory_stats``).  Pass
+        ``per_lane=False`` to skip the per-lane host gather (banks do, to
+        keep member snapshots light).
+        """
+        snap: Dict[str, Any] = self.metrics.snapshot(self.counters())
+        hot = self.hot_counters()
+        snap.update(hot)
+        snap["watermark"] = self._watermark
+        snap["event_time_lag_ms"] = (
+            int(time.time() * 1000) - self._watermark
+            if self._watermark is not None
+            else None
+        )
+        snap["per_pattern"] = {
+            self.name: {
+                **self.counters(),
+                **hot,
+                "records_in": self.metrics.records_in,
+                "matches_out": self.metrics.matches_out,
+            }
+        }
+        if per_lane:
+            snap["per_lane"] = self.batch.per_lane_counters(self.state)
+        snap["hbm"] = device_memory_stats()
+        return snap

@@ -58,6 +58,12 @@ from kafkastreams_cep_tpu.runtime.processor import (
 )
 from kafkastreams_cep_tpu.utils.events import Sequence
 from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
+from kafkastreams_cep_tpu.utils.telemetry import (
+    MetricsRegistry,
+    maybe_span,
+    positive_delta,
+    timed_histogram,
+)
 
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
@@ -230,6 +236,14 @@ class Supervisor:
         # resume would replay straight through into a wrong state.  Suspend
         # journaling until the next checkpoint re-establishes a clean base.
         self._journal_suspended = False
+        # Telemetry: the supervisor shares the processor's trace sink (pass
+        # ``trace_sink=`` like any processor kwarg) and owns the lifecycle
+        # latency histograms — checkpoint/recover/escalate cost as
+        # p50/p99, not just the bare integers above.
+        self.trace = self._proc_kwargs.get("trace_sink")
+        self.telemetry = MetricsRegistry()
+        for _n in ("checkpoint", "recover", "escalate"):
+            self.telemetry.histogram(f"phase.{_n}")
 
     @classmethod
     def resume(
@@ -271,6 +285,8 @@ class Supervisor:
         )
         sup._has_checkpoint = proc is not None
         sup._seq = base_seq
+        # An injected (restored) processor carries no telemetry wiring.
+        sup.processor.trace = sup.trace
         replayed = skipped = 0
         if sup._disk_journal is not None:
             for payload in sup._disk_journal.replay():
@@ -321,21 +337,25 @@ class Supervisor:
         :meth:`process` call returns them instead — flushing is
         observable emission and must never be dropped with the snapshot.
         """
-        if self.processor.pipeline:
-            self._unclaimed.extend(self.processor.flush())
-        tmp = self.checkpoint_path + ".tmp"
-        ckpt_mod.save_checkpoint(self.processor, tmp, extra={"seq": self._seq})
-        # Fault site: the crash window between writing the tmp snapshot
-        # and atomically installing it (utils/failpoints.py).
-        _failpoint("checkpoint.rename")
-        os.replace(tmp, self.checkpoint_path)
-        self._has_checkpoint = True
-        self._journal.clear()
-        if self._disk_journal is not None:
-            self._disk_journal.truncate()
-            self._journal_suspended = False  # clean base re-established
-        self._batches_since_ckpt = 0
-        self.checkpoints += 1
+        with maybe_span(self.trace, "checkpoint", seq=self._seq), \
+                timed_histogram(self.telemetry, "phase.checkpoint"):
+            if self.processor.pipeline:
+                self._unclaimed.extend(self.processor.flush())
+            tmp = self.checkpoint_path + ".tmp"
+            ckpt_mod.save_checkpoint(
+                self.processor, tmp, extra={"seq": self._seq}
+            )
+            # Fault site: the crash window between writing the tmp snapshot
+            # and atomically installing it (utils/failpoints.py).
+            _failpoint("checkpoint.rename")
+            os.replace(tmp, self.checkpoint_path)
+            self._has_checkpoint = True
+            self._journal.clear()
+            if self._disk_journal is not None:
+                self._disk_journal.truncate()
+                self._journal_suspended = False  # clean base re-established
+            self._batches_since_ckpt = 0
+            self.checkpoints += 1
         return self._drain_unclaimed()
 
     def _drain_unclaimed(self) -> List[Tuple[Hashable, Sequence]]:
@@ -348,6 +368,21 @@ class Supervisor:
         self, records: Seq[Record]
     ) -> List[Tuple[Hashable, Sequence]]:
         records = list(records)
+        # Correlation id: the journal seq this batch WILL get on success.
+        # Recovery/escalation spans fired while handling it carry the same
+        # id, so a trace walks from a fault to the batch that provoked it.
+        corr = f"batch-{self._seq + 1}"
+        with maybe_span(
+            self.trace, "supervisor.batch", corr=corr, seq=self._seq + 1,
+            records=len(records),
+        ) as sp:
+            matches = self._process_supervised(records, corr)
+            sp["matches"] = len(matches)
+            return matches
+
+    def _process_supervised(
+        self, records: List[Record], corr: str
+    ) -> List[Tuple[Hashable, Sequence]]:
         for attempt in range(self.max_retries + 1):
             try:
                 # Captured per attempt (a recovery resets the pipeline):
@@ -374,9 +409,9 @@ class Supervisor:
                     "processor failed on a %d-record batch; recovering",
                     len(records),
                 )
-                self._recover()
+                self._recover(corr)
         if self._policy is not None:
-            matches = self._maybe_escalate(records, matches, had_pending)
+            matches = self._maybe_escalate(records, matches, had_pending, corr)
         self._journal.append(records)
         self._seq += 1
         if self._disk_journal is not None:
@@ -444,6 +479,9 @@ class Supervisor:
                 self._pattern, self.checkpoint_path,
                 mesh=self._proc_kwargs.get("mesh"),
             )
+            # Checkpoints carry no telemetry wiring: reattach the trace
+            # sink so post-recovery batches keep emitting spans.
+            self.processor.trace = self.trace
         else:
             num_lanes = self.processor.num_lanes
             config = self.processor.batch.matcher.config
@@ -460,8 +498,17 @@ class Supervisor:
         self.processor.flush()
         return replayed
 
-    def _recover(self) -> None:
-        replayed = self._restore_tail()
+    def _recover(self, corr: Optional[str] = None) -> None:
+        # ``corr`` correlates the recovery span with the batch span whose
+        # failure provoked it (None when driven outside process(), e.g.
+        # a manual probe); the restore-and-replay cost lands in the
+        # ``recover`` latency histogram either way.
+        with maybe_span(
+            self.trace, "recover", corr=corr, seq=self._seq,
+        ) as sp, timed_histogram(self.telemetry, "phase.recover"):
+            replayed = self._restore_tail()
+            sp["replayed_records"] = replayed
+            sp["from_checkpoint"] = self._has_checkpoint
         self.recoveries += 1
         # Counters reverted with the state; re-snapshot the escalation
         # baseline BEFORE the retry re-runs the failing batch, or its
@@ -479,7 +526,8 @@ class Supervisor:
         return sizing.capacity_counters(self.processor.counters())
 
     def _maybe_escalate(
-        self, records, matches, had_pending: bool = False
+        self, records, matches, had_pending: bool = False,
+        corr: Optional[str] = None,
     ) -> List[Tuple[Hashable, Sequence]]:
         """Detect capacity loss in the batch just processed and recover it.
 
@@ -501,11 +549,7 @@ class Supervisor:
         if base is None:
             # First observation (fresh/restored processor): no delta yet.
             base = {k: 0 for k in counters} if self._seq == 0 else counters
-        tripped = {
-            k: v - base.get(k, 0)
-            for k, v in counters.items()
-            if v - base.get(k, 0) > 0
-        }
+        tripped = positive_delta(counters, base)
         if not tripped:
             self._counter_base = counters
             self._trip_streak = 0
@@ -545,56 +589,61 @@ class Supervisor:
                 )
                 self._counter_base = counters
                 return (kept + rerun) if rolled else matches
-            if redo_prev:
-                prev_batch = self._journal.pop()
-            # Roll back to the pre-batch state; a pending pipelined decode
-            # belongs to the lossy attempt and dies with the old processor.
-            self._restore_tail()
-            self.processor = migrate_mod.migrate_processor(
-                self._pattern, self.processor, new_cfg,
-                mesh=self._proc_kwargs.get("mesh"),
-            )
-            self.escalations += 1
-            logger.warning(
-                "capacity escalation #%d: %s after counters %s; "
-                "re-processing the %d-record batch at the new width",
-                self.escalations, {
-                    k: getattr(new_cfg, k)
-                    for k in ("max_runs", "slab_entries", "slab_preds",
-                              "dewey_depth", "max_walk")
-                }, tripped, len(records),
-            )
-            if redo_prev:
-                # The in-flight previous batch: its matches rode the
-                # discarded lossy return, so emit them from this re-run
-                # (a wider config never drops where the narrow one
-                # didn't, so this re-run is clean by construction).
-                kept = list(self.processor.process(prev_batch))
-                kept += self.processor.flush()
-                self._journal.append(prev_batch)
-                redo_prev = False
-            # Pin the wide config on disk before re-processing: a recovery
-            # or resume between here and the next periodic snapshot must
-            # replay at the new width, not the old one.
-            try:
-                self.checkpoint()
-            except Exception:
-                self.checkpoint_failures += 1
-                logger.exception(
-                    "post-escalation checkpoint failed; a recovery before "
-                    "the next good snapshot replays at the OLD width"
-                )
-            pre = self._capacity_counters()
-            rerun = self.processor.process(records)
-            if pipeline:
-                rerun = rerun + self.processor.flush()
-            rolled = True
-            counters = self._capacity_counters()
-            tripped = {
-                k: counters[k] - pre[k]
-                for k in counters
-                if counters[k] - pre[k] > 0
+            new_dims = {
+                k: getattr(new_cfg, k)
+                for k in ("max_runs", "slab_entries", "slab_preds",
+                          "dewey_depth", "max_walk")
             }
+            with maybe_span(
+                self.trace, "escalate", corr=corr, round=_round,
+                tripped=dict(tripped), new_config=new_dims,
+            ) as esp, timed_histogram(self.telemetry, "phase.escalate"):
+                if redo_prev:
+                    prev_batch = self._journal.pop()
+                # Roll back to the pre-batch state; a pending pipelined
+                # decode belongs to the lossy attempt and dies with the
+                # old processor.
+                self._restore_tail()
+                self.processor = migrate_mod.migrate_processor(
+                    self._pattern, self.processor, new_cfg,
+                    mesh=self._proc_kwargs.get("mesh"),
+                )
+                self.processor.trace = self.trace
+                self.escalations += 1
+                logger.warning(
+                    "capacity escalation #%d: %s after counters %s; "
+                    "re-processing the %d-record batch at the new width",
+                    self.escalations, new_dims, tripped, len(records),
+                )
+                if redo_prev:
+                    # The in-flight previous batch: its matches rode the
+                    # discarded lossy return, so emit them from this re-run
+                    # (a wider config never drops where the narrow one
+                    # didn't, so this re-run is clean by construction).
+                    kept = list(self.processor.process(prev_batch))
+                    kept += self.processor.flush()
+                    self._journal.append(prev_batch)
+                    redo_prev = False
+                # Pin the wide config on disk before re-processing: a
+                # recovery or resume between here and the next periodic
+                # snapshot must replay at the new width, not the old one.
+                try:
+                    self.checkpoint()
+                except Exception:
+                    self.checkpoint_failures += 1
+                    logger.exception(
+                        "post-escalation checkpoint failed; a recovery "
+                        "before the next good snapshot replays at the "
+                        "OLD width"
+                    )
+                pre = self._capacity_counters()
+                rerun = self.processor.process(records)
+                if pipeline:
+                    rerun = rerun + self.processor.flush()
+                rolled = True
+                counters = self._capacity_counters()
+                tripped = positive_delta(counters, pre)
+                esp["still_tripped"] = bool(tripped)
             if not tripped:
                 break
         else:
@@ -611,11 +660,26 @@ class Supervisor:
     def health(self) -> HealthReport:
         return check_health(self.processor)
 
-    def metrics_snapshot(self) -> dict:
-        out = self.processor.metrics_snapshot()
+    def metrics_snapshot(self, per_lane: bool = True) -> dict:
+        """The processor snapshot (per-phase latency histograms, per-lane
+        and per-pattern counter breakdowns, hot-tier counters, watermark
+        and HBM gauges) + supervisor lifecycle telemetry: the bare event
+        counts AND their latency histograms (``phases`` gains
+        ``checkpoint`` / ``recover`` / ``escalate`` with p50/p99) — when
+        they fired and what they cost, not just how many."""
+        out = self.processor.metrics_snapshot(per_lane=per_lane)
         out["recoveries"] = self.recoveries
         out["checkpoints"] = self.checkpoints
         out["checkpoint_failures"] = self.checkpoint_failures
         out["journal_failures"] = self.journal_failures
         out["escalations"] = self.escalations
+        phases = dict(out.get("phases") or {})
+        phases.update(
+            {
+                name[len("phase."):]: inst.snapshot()
+                for name, inst in self.telemetry.items()
+                if name.startswith("phase.")
+            }
+        )
+        out["phases"] = phases
         return out

@@ -167,7 +167,19 @@ class Failpoints:
         n = self._hits.get(site, 0)
         self._hits[site] = n + 1
         plan = self._plans.get(site)
-        if plan is not None and plan.should(n):
+        if plan is None:
+            return
+        raising = plan.should(n)
+        # Armed-site hits land in the trace stream (utils/telemetry.py)
+        # when a default sink is installed, so a chaos JSONL shows the
+        # injected fault right next to the recovery span it provoked.
+        # Only armed sites pay the lookup; disarmed cost is unchanged.
+        from kafkastreams_cep_tpu.utils.telemetry import get_default_sink
+
+        sink = get_default_sink()
+        if sink is not None:
+            sink.event("failpoint", site=site, hit=n, raised=raising)
+        if raising:
             raise (plan.exc() if plan.exc is not None else _default_exc(site))
 
 

@@ -1,10 +1,16 @@
 """Metrics & tracing — the ``StreamsMetrics`` analog the reference skips.
 
 The reference exposes Kafka Streams' metrics registry via the processor
-context but never records anything (SURVEY §5); here the runtime keeps real
-counters (records, matches, batches, device wall time) and the engine's
-overflow diagnostics are pulled into the same snapshot.  ``profile``
-wraps ``jax.profiler`` so a processor window can be captured for
+context but never records anything (SURVEY §5).  :class:`Metrics` keeps the
+runtime's counters (records, matches, batches, per-phase wall time) — now
+backed by a :class:`~kafkastreams_cep_tpu.utils.telemetry.MetricsRegistry`
+instead of ad-hoc dataclass fields, so every timed phase also lands in a
+fixed-log-bucket latency histogram (p50/p99 in ``snapshot()["phases"]``)
+and processor metrics merge across bank members (``registry.merge``).
+
+The attribute API (``metrics.records_in += n``, ``metrics.timed(attr)``)
+is unchanged; storage moved into the registry.  ``profile`` wraps
+``jax.profiler`` so a processor window can be captured for
 TensorBoard/XProf when tuning on real TPU hardware.
 """
 
@@ -12,40 +18,86 @@ from __future__ import annotations
 
 import contextlib
 import time
-from dataclasses import dataclass, field
-from typing import Dict, Iterator
+from typing import Dict, Iterator, Optional
+
+from kafkastreams_cep_tpu.utils.telemetry import (
+    LATENCY_EDGES_S,
+    MetricsRegistry,
+)
+
+#: Integer runtime counters, in their historical snapshot order.
+COUNTER_ATTRS = (
+    "records_in",
+    "matches_out",
+    "batches",
+    "duplicates_dropped",
+    "decode_fallbacks",
+)
+
+#: Wall-time accumulators; each also feeds the phase histogram of the same
+#: stem ("device_seconds" -> phases["device"]).
+SECONDS_ATTRS = (
+    "device_seconds",
+    "decode_seconds",
+    "pack_seconds",
+    "dispatch_seconds",
+    "gc_seconds",
+)
+
+#: The batch phases every processor pre-registers, so snapshots of runs
+#: that never hit a phase (e.g. gc off) still carry identical key sets.
+PHASE_NAMES = ("pack", "dispatch", "device", "decode", "gc")
 
 
-@dataclass
+def _counter_property(name: str) -> property:
+    def get(self) -> float:
+        return self.registry.counter(name).value
+
+    def set(self, v) -> None:
+        self.registry.counter(name).value = v
+
+    return property(get, set)
+
+
 class Metrics:
-    """Mutable counters for one processor (or bank member)."""
+    """Mutable counters for one processor (or bank member), registry-backed.
 
-    records_in: int = 0
-    matches_out: int = 0
-    batches: int = 0
-    duplicates_dropped: int = 0
-    decode_fallbacks: int = 0  # compacted decode overflowed its budget
-    device_seconds: float = 0.0
-    decode_seconds: float = 0.0
+    Counter attributes read/write registry counters; ``timed(attr)``
+    accumulates wall seconds into the ``attr`` counter AND observes the
+    corresponding phase latency histogram, so a single context manager
+    yields both the lifetime total and the percentile view.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        for n in COUNTER_ATTRS + SECONDS_ATTRS:
+            self.registry.counter(n)
+        for n in PHASE_NAMES:
+            self.registry.histogram(f"phase.{n}", LATENCY_EDGES_S)
 
     def snapshot(self, engine_counters: Dict[str, int]) -> Dict[str, float]:
         """One flat dict: runtime counters + engine overflow counters +
-        derived rates."""
+        derived rates + per-phase latency histograms (``"phases"``)."""
         out: Dict[str, float] = {
-            "records_in": self.records_in,
-            "matches_out": self.matches_out,
-            "batches": self.batches,
-            "duplicates_dropped": self.duplicates_dropped,
-            "decode_fallbacks": self.decode_fallbacks,
-            "device_seconds": round(self.device_seconds, 6),
-            "decode_seconds": round(self.decode_seconds, 6),
+            n: self.registry.counter(n).value for n in COUNTER_ATTRS
         }
-        if self.device_seconds > 0:
+        for n in SECONDS_ATTRS:
+            out[n] = round(self.registry.counter(n).value, 6)
+        if out["device_seconds"] > 0:
             out["events_per_second_device"] = round(
-                self.records_in / self.device_seconds, 1
+                out["records_in"] / out["device_seconds"], 1
             )
         out.update(engine_counters)
+        out["phases"] = self.phases()
         return out
+
+    def phases(self) -> Dict[str, dict]:
+        """Per-phase latency histogram snapshots (count/sum/p50/p99)."""
+        return {
+            name[len("phase."):]: inst.snapshot()
+            for name, inst in self.registry.items()
+            if name.startswith("phase.")
+        }
 
     @contextlib.contextmanager
     def timed(self, attr: str) -> Iterator[None]:
@@ -53,7 +105,17 @@ class Metrics:
         try:
             yield
         finally:
-            setattr(self, attr, getattr(self, attr) + time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.registry.counter(attr).value += dt
+            phase = attr[:-8] if attr.endswith("_seconds") else attr
+            self.registry.histogram(f"phase.{phase}", LATENCY_EDGES_S).observe(
+                dt
+            )
+
+
+for _n in COUNTER_ATTRS + SECONDS_ATTRS:
+    setattr(Metrics, _n, _counter_property(_n))
+del _n
 
 
 @contextlib.contextmanager
