@@ -25,7 +25,11 @@ oracle, ~2 min), ``CEP_BENCH_STENCIL_N`` / ``CEP_BENCH_STENCIL_INNER``
 (strict-SEQ stencil events and in-dispatch repeats), ``CEP_BENCH_EXTRAS``
 / ``CEP_BENCH_BUDGET_S`` / ``CEP_BENCH_{KLEENE,BANK,SHARD}_*`` (configs
 2-4), ``CEP_BENCH_HOT_ENTRIES`` (two-tier hot-window headline rerun,
-default 16, 0 skips), ``CEP_BENCH_METRICS=1`` (run the headline config
+default 16, 0 skips), ``CEP_BENCH_LAZY`` (lazy-extraction A/B on the
+headline trace, default 1; ``CEP_BENCH_LAZY_{CHUNK,RING,E}`` set the
+drain cadence, handle-ring size, and slab headroom),
+``CEP_BENCH_FRONTIER`` ("E:EH,E:EH,…" — the (E, E_hot) frontier sweep,
+off by default), ``CEP_BENCH_METRICS=1`` (run the headline config
 under the telemetry Reporter and print the per-phase p50/p99 block;
 ``CEP_BENCH_METRICS_{K,T,BATCHES}`` size it), ``CEP_PLATFORM`` (force a
 JAX platform, e.g. ``cpu``).
@@ -364,7 +368,7 @@ def bench_engine(K, T, reps):
         prices = np.asarray(events.value["price"])
         volumes = np.asarray(events.value["volume"])
         lanes = list(range(0, K, max(K // n_lanes, 1)))[:n_lanes]
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # host-timed (oracle replay + host decode)
         recall, precision, n_oracle = measure_recall(
             out, batch.names, prices, volumes, lanes
         )
@@ -418,6 +422,7 @@ def bench_engine(K, T, reps):
     # it (hot-hit rate = the fraction of walk hops that paid an E_hot-sized
     # reduce instead of an E-sized one).
     hot_n = int(os.environ.get("CEP_BENCH_HOT_ENTRIES", "16"))
+    lazy_metrics = None
     hot_metrics = None
     if hot_n > 0 and hot_n % 8 == 0 and hot_n < cfg.slab_entries:
         try:
@@ -467,7 +472,182 @@ def bench_engine(K, T, reps):
             log(f"hot-tier bench failed: {type(e).__name__}: {e}")
     else:
         log(f"engine[hot]: skipped (CEP_BENCH_HOT_ENTRIES={hot_n})")
-    return K * T / best, spread, counters, recall, precision, hot_metrics
+
+    # Lazy extraction A/B (ISSUE 4): the same trace eager vs lazy at the
+    # same shapes, drained at a processor-like chunk cadence; reports the
+    # per-step hop reduction (the device critical-path win), hot-hit-rate
+    # delta, and match-slot parity.  CEP_BENCH_LAZY=0 skips.
+    if os.environ.get("CEP_BENCH_LAZY", "1") == "1":
+        try:
+            lazy_metrics = bench_lazy_block(K, T, reps, cfg, events, hot_n)
+        except Exception as e:  # never break the headline
+            log(f"lazy bench failed: {type(e).__name__}: {e}")
+    else:
+        log("engine[lazy]: skipped (CEP_BENCH_LAZY=0)")
+    # (E, E_hot) frontier sweep hook (PROFILE_r06 next-leverage item 3):
+    # CEP_BENCH_FRONTIER="48:16,48:24,64:16" reruns the headline trace at
+    # each point; off by default.
+    frontier = os.environ.get("CEP_BENCH_FRONTIER", "")
+    if frontier:
+        try:
+            pts = bench_frontier(K, T, reps, events, cfg, frontier)
+            if lazy_metrics is not None:
+                lazy_metrics["frontier"] = pts
+        except Exception as e:
+            log(f"frontier sweep failed: {type(e).__name__}: {e}")
+    return (K * T / best, spread, counters, recall, precision, hot_metrics,
+            lazy_metrics)
+
+
+def _chunked_scan(batch, events, chunk, lazy):
+    """One chunk-cadence pass over ``events`` (drain between chunks when
+    lazy — the processor's cadence), returning ``(state, match_slots)``.
+    Every chunk's outputs materialize through a consumed reduction
+    (``int(...)``), so the timing caller cannot be fooled by JAX's async
+    dispatch (PROFILE_r05 finding 1)."""
+    import jax as _jax
+
+    state = batch.init_state()
+    n = 0
+    T = int(events.ts.shape[1])
+    for t0 in range(0, T, chunk):
+        ev = _jax.tree_util.tree_map(
+            lambda x: x[:, t0:t0 + chunk], events
+        )
+        state, out = batch.scan(state, ev)
+        if lazy:
+            state, drained = batch.drain(state)
+            n += int(jnp.sum(drained.count > 0))  # consumed reduction
+        else:
+            n += int(jnp.sum(out.count > 0))  # consumed reduction
+    jax.block_until_ready(state.slab.stage)
+    return state, n
+
+
+def bench_lazy_block(K, T, reps, base_cfg, events, hot_n):
+    """Eager vs lazy at identical shapes on the headline trace (ISSUE 4).
+
+    Both sides run the same chunk cadence (scan chunk + [drain] per
+    chunk) so the comparison isolates WHERE the extraction hops run, not
+    how the scan is sliced.  Reported: ev/s both ways, per-step device
+    hop reduction (walk_hops + extract_hops, the lockstep critical path),
+    drain-hop conservation, hot-hit-rate delta at E_hot=hot_n, and
+    match-slot parity; handle_overflows is printed so a too-small ring
+    can never masquerade as a win.
+    """
+    import dataclasses
+
+    chunk = int(os.environ.get("CEP_BENCH_LAZY_CHUNK", "64"))
+    ring = int(os.environ.get("CEP_BENCH_LAZY_RING", "512"))
+    # Slab headroom for BOTH sides (default 2x the headline E): the lazy
+    # engine holds completed chains until the drain, so at the
+    # capacity-crushed headline E the two sides shed different branches
+    # and parity becomes a drop-policy comparison instead of an
+    # extraction-placement one.  CEP_BENCH_LAZY_E=0 keeps the headline E
+    # to see exactly that effect (reported, never hidden).
+    lazy_e = int(
+        os.environ.get("CEP_BENCH_LAZY_E", str(2 * base_cfg.slab_entries))
+    )
+    ecfg = dataclasses.replace(
+        base_cfg,
+        slab_hot_entries=hot_n,
+        slab_entries=lazy_e or base_cfg.slab_entries,
+    )
+    lcfg = dataclasses.replace(
+        ecfg, lazy_extraction=True, handle_ring=ring
+    )
+    out = {}
+    runs = {}
+    for label, cfg, lazy in (("eager", ecfg, False), ("lazy", lcfg, True)):
+        batch = BatchMatcher(stock_demo.stock_pattern(), K, cfg)
+        t0 = time.perf_counter()
+        state, n = _chunked_scan(batch, events, chunk, lazy)
+        log(f"engine[lazy A/B {label}]: compile+first "
+            f"{time.perf_counter() - t0:.1f}s")
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            state, n = _chunked_scan(batch, events, chunk, lazy)
+            best = min(best, time.perf_counter() - t0)
+        runs[label] = (batch, state, n, best)
+    (eb, es, en, ebest), (lb, ls, ln, lbest) = runs["eager"], runs["lazy"]
+    we, wl = eb.walk_counters(es), lb.walk_counters(ls)
+    step_e = we["walk_hops"] + we["extract_hops"]
+    step_l = wl["walk_hops"] + wl["extract_hops"]
+    reduction = 1 - step_l / step_e if step_e else 0.0
+
+    def rate(h):
+        t = h["slab_hot_hits"] + h["slab_hot_misses"]
+        return h["slab_hot_hits"] / t if t else 1.0
+
+    # NOTE: the lazy hot counters include drain-pass hops; the step-phase
+    # rate (drain excluded) is what the two-tier reduce-width model sees —
+    # approximate it by removing the drain share proportionally is wrong,
+    # so report both raw rates and the hop classes for offline analysis.
+    ovf = lb.counters(ls)["handle_overflows"]
+    out = {
+        "eager_evps": round(K * T / ebest, 1),
+        "lazy_evps": round(K * T / lbest, 1),
+        "speedup": round(ebest / lbest, 3),
+        "step_hop_reduction": round(reduction, 4),
+        "drain_hops_conserved": wl["drain_hops"] == we["extract_hops"],
+        "hot_hit_rate_eager": round(rate(eb.hot_counters(es)), 4),
+        "hot_hit_rate_lazy": round(rate(lb.hot_counters(ls)), 4),
+        "match_slots_eager": en,
+        "match_slots_lazy": ln,
+        "match_slot_parity": en == ln,
+        "handle_overflows": ovf,
+        "walk_counters_eager": we,
+        "walk_counters_lazy": wl,
+        "chunk": chunk,
+        "handle_ring": ring,
+    }
+    log(
+        f"engine[lazy A/B, chunk={chunk}]: eager {K * T / ebest / 1e3:.0f}K"
+        f" ev/s vs lazy {K * T / lbest / 1e3:.0f}K ev/s "
+        f"({ebest / lbest:.2f}x); step-hop reduction {reduction:.1%}, "
+        f"match slots {en} vs {ln} (parity={en == ln}, "
+        f"handle_overflows={ovf}), hot-hit rate "
+        f"{out['hot_hit_rate_eager']:.3f} -> {out['hot_hit_rate_lazy']:.3f}"
+    )
+    return out
+
+
+def bench_frontier(K, T, reps, events, base_cfg, spec):
+    """(E, E_hot) frontier sweep: rerun the headline trace at each
+    ``E:EH`` point of ``spec`` (comma-separated) with the two-tier walk
+    kernels enabled — places the new frontier next to PROFILE_r05's
+    E-linear line on chip."""
+    import dataclasses
+
+    pts = {}
+    for pair in spec.split(","):
+        e_s, eh_s = pair.strip().split(":")
+        E, EH = int(e_s), int(eh_s)
+        cfg = dataclasses.replace(
+            base_cfg, slab_entries=E, slab_hot_entries=EH
+        )
+        batch = BatchMatcher(stock_demo.stock_pattern(), K, cfg)
+        state0 = batch.init_state()
+        state, out = batch.scan(state0, events)
+        jax.block_until_ready(out.count)
+        best = float("inf")
+        for _ in range(max(reps - 2, 1)):
+            t0 = time.perf_counter()
+            state, out = batch.scan(state0, events)
+            jax.block_until_ready(out.count)
+            best = min(best, time.perf_counter() - t0)
+        hot = batch.hot_counters(state)
+        hops = hot["slab_hot_hits"] + hot["slab_hot_misses"]
+        rate = hot["slab_hot_hits"] / hops if hops else 1.0
+        pts[f"{E}:{EH}"] = {
+            "evps": round(K * T / best, 1),
+            "hot_hit_rate": round(rate, 4),
+        }
+        log(f"frontier[E={E},EH={EH}]: {K * T / best / 1e3:.0f}K ev/s, "
+            f"hot-hit rate {rate:.3f}")
+        del batch, state0, state, out
+    return pts
 
 
 def bench_stencil(total_events, reps):
@@ -808,11 +988,11 @@ def bench_processor(K, T, n_batches):
             keys, {"price": prices, "volume": volumes}, ts
         )
 
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # host-timed (decode device_gets materialize)
     feed(0)
     proc.flush()
     log(f"processor: compile+first batch {time.perf_counter() - t0:.1f}s")
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # host-timed (decode device_gets materialize)
     n_matches = 0
     for b in range(1, n_batches + 1):
         n_matches += len(feed(b))
@@ -949,12 +1129,12 @@ def bench_resilience():
         )
         for b in range(n_batches):
             sup.process(mk_batch(b))
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # host-timed (checkpoint device_gets)
         sup.checkpoint()
         out["checkpoint_s"] = round(time.perf_counter() - t0, 3)
         for b in range(n_batches, 2 * n_batches):
             sup.process(mk_batch(b))
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # host-timed (restore + replay)
         sup._recover()  # restore + replay the n_batches journal tail
         out["recover_s"] = round(time.perf_counter() - t0, 3)
 
@@ -972,9 +1152,9 @@ def bench_resilience():
         # max_runs=8 within a few batches.
         esc.process(mk_batch(100, spike=0.2))
         b = 101
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # host-timed (escalation cycle)
         while esc.escalations == 0 and b < 120:
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # host-timed (escalation cycle)
             esc.process(mk_batch(b, spike=0.2))
             b += 1
         if esc.escalations:
@@ -997,7 +1177,7 @@ def bench_oracle(n_events):
     prices = rng.integers(90, 131, size=n_events)
     volumes = rng.integers(600, 1101, size=n_events)
     oracle = OracleNFA.from_pattern(stock_demo.stock_pattern())
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # host-timed (pure-Python oracle loop)
     n_matches = 0
     early_dt = None
     for i in range(n_events):
@@ -1022,7 +1202,7 @@ def bench_oracle(n_events):
 
 
 def main():
-    t_start = time.perf_counter()
+    t_start = time.perf_counter()  # host-timed (wall budget)
     K = int(os.environ.get("CEP_BENCH_K", "4096"))
     T = int(os.environ.get("CEP_BENCH_T", "256"))
     reps = int(os.environ.get("CEP_BENCH_REPS", "5"))
@@ -1035,7 +1215,7 @@ def main():
     parity_gate()
     bench_stencil(int(os.environ.get("CEP_BENCH_STENCIL_N", "1048576")), reps)
     (engine_evps, engine_spread, engine_counters, recall, precision,
-     hot_metrics) = bench_engine(K, T, reps)
+     hot_metrics, lazy_metrics) = bench_engine(K, T, reps)
     if os.environ.get("CEP_BENCH_LOSSFREE", "1") != "0":
         lf_evps, lf_zero, lf_parity = bench_lossfree(
             int(os.environ.get("CEP_BENCH_LOSSFREE_K", "1024")),
@@ -1171,6 +1351,9 @@ def main():
                 # Two-tier hot-window run on the same trace/shapes (None
                 # when CEP_BENCH_HOT_ENTRIES=0 or the run failed).
                 "hot_tier": hot_metrics,
+                # Lazy-extraction A/B on the same trace/shapes (ISSUE 4;
+                # None when CEP_BENCH_LAZY=0 or the run failed).
+                "lazy": lazy_metrics,
                 "lossfree_evps": round(lf_evps, 1),
                 "lossfree_counters_zero": bool(lf_zero),
                 "lossfree_oracle_parity": bool(lf_parity),

@@ -3,6 +3,7 @@ fast path, and the session wrapper."""
 
 from kafkastreams_cep_tpu.engine.matcher import (
     ArrayStates,
+    DrainOutput,
     EngineConfig,
     EngineState,
     EventBatch,
@@ -27,6 +28,7 @@ from kafkastreams_cep_tpu.engine.stencil import (
 
 __all__ = [
     "ArrayStates",
+    "DrainOutput",
     "EngineConfig",
     "EngineState",
     "EscalationPolicy",

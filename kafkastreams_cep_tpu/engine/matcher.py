@@ -160,6 +160,28 @@ class EngineConfig:
     # the same per-entry op order (see ops/slab.py) and is ~2 orders of
     # magnitude faster on TPU; this switch exists for differential testing.
     sequential_slab: bool = False
+    # Lazy match extraction (PROFILE_r06 "next leverage" item 1): when True,
+    # a run reaching the final stage no longer dispatches its W-hop
+    # extraction walk inside the per-step walk pass — the dominant walker
+    # class and the main source of two-tier hot misses on match-dense
+    # traces (PROFILE_r05 finding 2).  Instead the step emits a fixed-width
+    # *handle* (root stage, root offset, Dewey version, completion step +
+    # run row + timestamp) into a per-lane handle ring and *pins* the
+    # referenced chain (refcount +1 at the root, so no removal walk can
+    # delete it before drain; the maintenance sweep additionally roots
+    # pending handles).  Materialization moves to the batched drain pass
+    # (``TPUMatcher.drain`` / ``BatchMatcher.drain``) that unpins and walks
+    # all pending handles together, off the per-step critical path.  The
+    # drained match set is identical to the eager engine's
+    # (tests/test_lazy_extraction.py); eager mode remains the differential
+    # oracle.
+    lazy_extraction: bool = False
+    # HB — per-lane handle-ring slots (multiple of 8, TPU sublane tile).
+    # Must hold every match completed between drains; a full ring drops the
+    # match and counts ``handle_overflows`` (a loss counter: all-zero means
+    # loss-free, like every other capacity knob — sizing.suggest derives it
+    # from the probe's per-chunk match maxima).
+    handle_ring: int = 16
 
 
 class EventBatch(NamedTuple):
@@ -192,6 +214,19 @@ class EngineState(NamedTuple):
     slab: slab_mod.SlabState
     run_drops: jnp.ndarray  # scalar int32 — queue-overflow drops
     ver_overflows: jnp.ndarray  # scalar int32 — Dewey add_stage overflows
+    # --- lazy-extraction handle ring (EngineConfig.lazy_extraction; all
+    #     fields inert under the eager engine).  Slots [0, hr_count) hold
+    #     pending match handles in completion order; drain clears them.
+    hr_stage: jnp.ndarray  # [HB] int32 — root identity stage (-1 free)
+    hr_off: jnp.ndarray  # [HB] int32 — root event offset (walk origin)
+    hr_ver: jnp.ndarray  # [HB, D] int32 — walk version at completion
+    hr_vlen: jnp.ndarray  # [HB] int32
+    hr_ts: jnp.ndarray  # [HB] int32 — completing event's (rebased) ts
+    hr_seq: jnp.ndarray  # [HB] int32 — step_seq at completion (ordering)
+    hr_row: jnp.ndarray  # [HB] int32 — completing run row (queue order)
+    hr_count: jnp.ndarray  # scalar int32 — pending handles
+    step_seq: jnp.ndarray  # scalar int32 — monotone per-lane step counter
+    handle_overflows: jnp.ndarray  # scalar int32 — ring-full match drops
 
 
 class StepOutput(NamedTuple):
@@ -205,6 +240,24 @@ class StepOutput(NamedTuple):
     stage: jnp.ndarray  # [R, W] int32 — identity positions
     off: jnp.ndarray  # [R, W] int32 — event offsets
     count: jnp.ndarray  # [R] int32
+
+
+class DrainOutput(NamedTuple):
+    """One drain pass's materialized matches, in ring (completion) order.
+
+    Row ``h`` is handle ``h`` of the ring at drain time: ``count[h] == 0``
+    past the pending prefix.  ``seq``/``row`` recover the eager engine's
+    emission order ((completing step, run-queue row) — the processor sorts
+    drained matches by them), ``ts`` the completing event's timestamp.
+    All leading axes batch ([K] under the lane-batched matchers).
+    """
+
+    stage: jnp.ndarray  # [HB, W] int32
+    off: jnp.ndarray  # [HB, W] int32
+    count: jnp.ndarray  # [HB] int32
+    seq: jnp.ndarray  # [HB] int32
+    row: jnp.ndarray  # [HB] int32
+    ts: jnp.ndarray  # [HB] int32
 
 
 def _as_bool(x) -> jnp.ndarray:
@@ -245,6 +298,7 @@ COUNTER_NAMES = (
     "slab_missing",
     "slab_trunc",
     "walk_collisions",
+    "handle_overflows",
 )
 
 # Two-tier residency telemetry (EngineConfig.slab_hot_entries) — kept OUT of
@@ -260,6 +314,19 @@ HOT_COUNTER_NAMES = (
     "slab_demotions",
 )
 
+# Walk-cost telemetry (PROFILE_r05/r06: the walk pass is compute-bound on
+# per-hop reduces x lockstep trip counts) — like HOT_COUNTER_NAMES these are
+# NOT loss indicators and live outside COUNTER_NAMES; they make the
+# reduce-width perf model measurable on CPU CI.  ``extract_hops`` counts
+# eager in-step extraction walk hops; ``drain_hops`` the deferred drain
+# pass's (lazy_extraction); ``walk_hops`` everything else (branch refcount
+# walks, dead-run removals).
+WALK_COUNTER_NAMES = (
+    "walk_hops",
+    "extract_hops",
+    "drain_hops",
+)
+
 
 def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
     """The counters of ``state`` in ``COUNTER_NAMES`` order."""
@@ -271,6 +338,7 @@ def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
         state.slab.missing,
         state.slab.trunc,
         state.slab.collisions,
+        state.handle_overflows,
     )
 
 
@@ -284,14 +352,29 @@ def hot_counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
     )
 
 
+def walk_counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
+    """The walk-cost counters of ``state`` in ``WALK_COUNTER_NAMES``
+    order."""
+    return (
+        state.slab.walk_hops,
+        state.slab.extract_hops,
+        state.slab.drain_hops,
+    )
+
+
 def per_lane_counter_arrays(state: "EngineState") -> Dict[str, Any]:
-    """Un-summed counter arrays (drop + hot), one host int64 array per
-    name, for per-lane attribution (telemetry pillar 3): a ``[K]``-batched
-    state yields ``[K]`` arrays — which lane is burning capacity — while a
-    single-lane state yields scalars.  One ``device_get`` for all of them.
+    """Un-summed counter arrays (drop + hot + walk-cost), one host int64
+    array per name, for per-lane attribution (telemetry pillar 3): a
+    ``[K]``-batched state yields ``[K]`` arrays — which lane is burning
+    capacity — while a single-lane state yields scalars.  One
+    ``device_get`` for all of them.
     """
-    names = COUNTER_NAMES + HOT_COUNTER_NAMES
-    vals = jax.device_get(counter_values(state) + hot_counter_values(state))
+    names = COUNTER_NAMES + HOT_COUNTER_NAMES + WALK_COUNTER_NAMES
+    vals = jax.device_get(
+        counter_values(state)
+        + hot_counter_values(state)
+        + walk_counter_values(state)
+    )
     return {
         n: np.asarray(v).astype(np.int64) for n, v in zip(names, vals)
     }
@@ -374,6 +457,13 @@ def _build_step(tables, cfg: EngineConfig):
                 f"below slab_entries={cfg.slab_entries} (0 disables the "
                 "two-tier layout)"
             )
+    HB = cfg.handle_ring
+    if HB <= 0 or HB % 8:
+        raise ValueError(
+            f"handle_ring={HB} must be a positive multiple of 8 (TPU "
+            "sublane tile; the ring is engine state even under the eager "
+            "engine)"
+        )
     H = tables.max_hops
     NS = max(max(t.num_states for t in tlist), 1)
     S_CAND = 1 + H + 1  # survivor, branch per hop, re-seed
@@ -708,6 +798,11 @@ def _build_step(tables, cfg: EngineConfig):
         off = jnp.asarray(ev.off, i32)
         valid = _as_bool(ev.valid)
         final_en = rec.surv_alive & rec.surv_final & valid
+        if cfg.lazy_extraction:
+            # Lazy extraction: completed matches become ring handles
+            # (finish()) instead of W-hop extraction walkers — the final
+            # segment keeps its rows (layout is static) but never enables.
+            final_en = jnp.zeros_like(final_en)
 
         prev_off_rep = jnp.repeat(state.event_off, H)
 
@@ -788,6 +883,7 @@ def _build_step(tables, cfg: EngineConfig):
                 slab, jnp.maximum(get_at(state.id_pos, r), 0), prev_off,
                 get_at(state.ver, r), get_at(state.vlen, r), W,
                 remove=True, enable=dead_en, hot_entries=EH,
+                hop_kind="walk",
             )
             return slab
 
@@ -806,17 +902,24 @@ def _build_step(tables, cfg: EngineConfig):
 
         if cfg.sequential_slab:
             slab = jax.lax.fori_loop(0, R, run_body, state.slab)
-            # Match construction for final states, after all runs
-            # (NFA.java:111-115), in queue order.
-            slab, out_stage, out_off, out_count = jax.lax.fori_loop(
-                0, R, fin_body,
-                (
-                    slab,
-                    jnp.full((R, W), -1, i32),
-                    jnp.full((R, W), -1, i32),
-                    jnp.zeros((R,), i32),
-                ),
-            )
+            if cfg.lazy_extraction:
+                # Lazy: finish() appends handles instead; no in-step
+                # extraction walks at all.
+                out_stage = jnp.full((R, W), -1, i32)
+                out_off = jnp.full((R, W), -1, i32)
+                out_count = jnp.zeros((R,), i32)
+            else:
+                # Match construction for final states, after all runs
+                # (NFA.java:111-115), in queue order.
+                slab, out_stage, out_off, out_count = jax.lax.fori_loop(
+                    0, R, fin_body,
+                    (
+                        slab,
+                        jnp.full((R, W), -1, i32),
+                        jnp.full((R, W), -1, i32),
+                        jnp.zeros((R,), i32),
+                    ),
+                )
         else:
             # One walk pass serves every walker of the step — branch
             # refcount walks (deepest-first per run, NFA.java:231-246),
@@ -924,6 +1027,64 @@ def _build_step(tables, cfg: EngineConfig):
             return jnp.where(got, vals, jnp.asarray(fill, flat.dtype))
 
         new_alive = jnp.any(ohm & flat_alive[:, None], axis=0)
+
+        # --- Lazy extraction: append completed matches to the handle ring
+        # and pin each root (refs +1) so no removal walk can delete the
+        # chain's root entry before the drain pass unpins and walks it.
+        hr = dict(
+            hr_stage=state.hr_stage, hr_off=state.hr_off,
+            hr_ver=state.hr_ver, hr_vlen=state.hr_vlen,
+            hr_ts=state.hr_ts, hr_seq=state.hr_seq, hr_row=state.hr_row,
+            hr_count=state.hr_count,
+            handle_overflows=state.handle_overflows,
+        )
+        if cfg.lazy_extraction:
+            off = jnp.asarray(ev.off, i32)
+            ts = jnp.asarray(ev.ts, i32)
+            final_en = rec.surv_alive & rec.surv_final & valid
+            rank = jnp.cumsum(final_en.astype(i32)) - 1
+            dst = state.hr_count + rank
+            fit = final_en & (dst < HB)
+            m = fit[:, None] & (
+                jnp.arange(HB, dtype=i32)[None, :] == dst[:, None]
+            )  # [R, HB] — at most one True per row and per column
+            got = jnp.any(m, axis=0)
+
+            def ring_set(cur, val):
+                if val.ndim == 1:
+                    upd = jnp.sum(jnp.where(m, val[:, None], 0), axis=0)
+                    return jnp.where(got, upd.astype(cur.dtype), cur)
+                upd = jnp.sum(
+                    jnp.where(m[:, :, None], val[:, None, :], 0), axis=0
+                )
+                return jnp.where(got[:, None], upd.astype(cur.dtype), cur)
+
+            pin = jnp.sum(
+                (
+                    (slab.stage[None, :] == rec.surv_id[:, None])
+                    & (slab.off[None, :] == off)
+                    & fit[:, None]
+                ).astype(i32),
+                axis=0,
+            )
+            slab = slab._replace(refs=slab.refs + pin)
+            hr = dict(
+                hr_stage=ring_set(state.hr_stage, rec.surv_id),
+                hr_off=ring_set(
+                    state.hr_off, jnp.broadcast_to(off, (R,))
+                ),
+                hr_ver=ring_set(state.hr_ver, rec.surv_ver),
+                hr_vlen=ring_set(state.hr_vlen, rec.surv_vlen),
+                hr_ts=ring_set(state.hr_ts, jnp.broadcast_to(ts, (R,))),
+                hr_seq=ring_set(
+                    state.hr_seq, jnp.broadcast_to(state.step_seq, (R,))
+                ),
+                hr_row=ring_set(state.hr_row, jnp.arange(R, dtype=i32)),
+                hr_count=state.hr_count + jnp.sum(fit.astype(i32)),
+                handle_overflows=state.handle_overflows
+                + jnp.sum((final_en & ~fit).astype(i32)),
+            )
+
         new_state = EngineState(
             alive=new_alive,
             id_pos=compact(c_id, -1),
@@ -937,6 +1098,8 @@ def _build_step(tables, cfg: EngineConfig):
             slab=slab,
             run_drops=state.run_drops + dropped,
             ver_overflows=state.ver_overflows + jnp.sum(rec.ovf),
+            step_seq=state.step_seq,
+            **hr,
         )
 
         # Padding steps leave the state untouched and emit nothing.
@@ -946,6 +1109,9 @@ def _build_step(tables, cfg: EngineConfig):
             ) if n.ndim else jnp.where(valid, n, o),
             new_state, state,
         )
+        # The step counter ticks on every step, padding included — it is
+        # the StepOutput ``t`` index (handle ordering), not match state.
+        new_state = new_state._replace(step_seq=state.step_seq + 1)
         out = StepOutput(
             stage=jnp.where(valid, out_stage, -1),
             off=jnp.where(valid, out_off, -1),
@@ -969,6 +1135,16 @@ def _build_step(tables, cfg: EngineConfig):
             slab=slab_mod.make(cfg.slab_entries, cfg.slab_preds, D),
             run_drops=jnp.zeros((), i32),
             ver_overflows=jnp.zeros((), i32),
+            hr_stage=jnp.full((HB,), -1, i32),
+            hr_off=jnp.full((HB,), -1, i32),
+            hr_ver=jnp.zeros((HB, D), i32),
+            hr_vlen=jnp.zeros((HB,), i32),
+            hr_ts=jnp.zeros((HB,), i32),
+            hr_seq=jnp.zeros((HB,), i32),
+            hr_row=jnp.zeros((HB,), i32),
+            hr_count=jnp.zeros((), i32),
+            step_seq=jnp.zeros((), i32),
+            handle_overflows=jnp.zeros((), i32),
         )
 
     phases = StepPhases(
@@ -982,6 +1158,70 @@ def _build_step(tables, cfg: EngineConfig):
         hot_entries=EH,
     )
     return step, init_state, phases
+
+
+def build_drain(cfg: EngineConfig):
+    """The per-lane batched drain pass for ``cfg`` — a pure jittable
+    ``drain(state) -> (state, DrainOutput)``.
+
+    Unpins every pending handle's root (the emission-time refcount +1,
+    ``finish``), then walks all handles together through the step walk
+    machinery (``ops/slab.py: walks_compacted`` with ``drain=True`` hop
+    accounting) with full removal semantics — exactly the walks the eager
+    engine would have run in-step, in the same per-handle order (ring
+    order = completion order; ``budget=1`` default runs each alone).  The
+    ring is cleared.  A no-op on an empty ring (and under the eager
+    engine), so callers may drain unconditionally.  Table-free: one drain
+    works for any pattern compiled at the same shapes, stacked banks
+    included.
+    """
+    HB, W, EH, D = (
+        cfg.handle_ring, cfg.max_walk, cfg.slab_hot_entries,
+        cfg.dewey_depth,
+    )
+    i32 = jnp.int32
+
+    def drain(state: EngineState) -> Tuple[EngineState, DrainOutput]:
+        pending = jnp.arange(HB, dtype=i32) < state.hr_count
+        slab = state.slab
+        unpin = jnp.sum(
+            (
+                (slab.stage[None, :] == state.hr_stage[:, None])
+                & (slab.off[None, :] == state.hr_off[:, None])
+                & pending[:, None]
+            ).astype(i32),
+            axis=0,
+        )
+        slab = slab._replace(refs=jnp.maximum(slab.refs - unpin, 0))
+        ones = jnp.ones((HB,), bool)
+        slab, out_stage, out_off, count = slab_mod.walks_compacted(
+            slab, pending, state.hr_stage, state.hr_off, state.hr_ver,
+            state.hr_vlen, ones, ones, W,
+            budget=cfg.walker_budget, out_base=0, out_rows=HB,
+            hot_entries=EH, drain=True,
+        )
+        out = DrainOutput(
+            stage=out_stage,
+            off=out_off,
+            count=jnp.where(pending, count, 0),
+            seq=jnp.where(pending, state.hr_seq, -1),
+            row=jnp.where(pending, state.hr_row, -1),
+            ts=jnp.where(pending, state.hr_ts, -1),
+        )
+        state = state._replace(
+            slab=slab,
+            hr_stage=jnp.full((HB,), -1, i32),
+            hr_off=jnp.full((HB,), -1, i32),
+            hr_ver=jnp.zeros((HB, D), i32),
+            hr_vlen=jnp.zeros((HB,), i32),
+            hr_ts=jnp.zeros((HB,), i32),
+            hr_seq=jnp.zeros((HB,), i32),
+            hr_row=jnp.zeros((HB,), i32),
+            hr_count=jnp.zeros((), i32),
+        )
+        return state, out
+
+    return drain
 
 
 class TPUMatcher:
@@ -1014,6 +1254,8 @@ class TPUMatcher:
         self._phases = phases
         self.step = jax.jit(step)
         self.scan = jax.jit(self._scan)
+        self._drain_fn = build_drain(self.config)
+        self.drain = jax.jit(self._drain_fn)
 
     @property
     def names(self) -> List[str]:
@@ -1039,6 +1281,14 @@ class TPUMatcher:
         return {
             n: int(v)
             for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
+        }
+
+    def walk_counters(self, state: EngineState) -> Dict[str, int]:
+        """Walk-cost telemetry (per-hop device work by walker class) —
+        like :meth:`hot_counters`, not loss indicators."""
+        return {
+            n: int(v)
+            for n, v in zip(WALK_COUNTER_NAMES, walk_counter_values(state))
         }
 
 
@@ -1081,6 +1331,12 @@ class MatcherSession:
             valid=jnp.asarray(True),
         )
         self.state, out = self.matcher.step(self.state, ev)
+        if self.matcher.config.lazy_extraction:
+            # Per-event sessions drain immediately so the oracle-style
+            # match() contract (matches returned by the completing event)
+            # holds; batch callers drain at scan cadence instead.
+            self.state, drained = self.matcher.drain(self.state)
+            return self.decode_drained(drained)
         return self.decode(out)
 
     def decode(self, out: StepOutput) -> List[Sequence]:
@@ -1095,6 +1351,25 @@ class MatcherSession:
             seq = Sequence()
             for w in range(n):
                 seq.add(names[int(stage[r, w])], self._events[int(off[r, w])])
+            matches.append(seq)
+        return matches
+
+    def decode_drained(self, out: DrainOutput) -> List[Sequence]:
+        """Materialize a drain pass's matches (already in completion
+        order — ring order)."""
+        stage, off, count = (
+            np.asarray(jax.device_get(x))
+            for x in (out.stage, out.off, out.count)
+        )
+        names = self.matcher.names
+        matches: List[Sequence] = []
+        for h in range(count.shape[0]):
+            n = int(count[h])
+            if n == 0:
+                continue
+            seq = Sequence()
+            for w in range(n):
+                seq.add(names[int(stage[h, w])], self._events[int(off[h, w])])
             matches.append(seq)
         return matches
 

@@ -54,6 +54,7 @@ _COUNTER_KNOB = {
     "slab_full_drops": "slab_entries",
     "slab_pred_drops": "slab_preds",
     "slab_trunc": "max_walk",
+    "handle_overflows": "handle_ring",
 }
 
 
@@ -66,6 +67,9 @@ class ProbeReport(NamedTuple):
     max_npreds: int  # pointer-list width in use
     max_vlen: int  # deepest Dewey version (runs and pointers)
     max_match_len: int  # longest extracted match
+    max_matches_chunk: int  # matches completed per lane per chunk — the
+    #   handle-ring working set under lazy extraction (drain runs at scan
+    #   cadence, so one chunk's completions must fit the ring)
     config: EngineConfig
 
 
@@ -98,7 +102,7 @@ def probe(
     batch = BatchMatcher(pattern, K, config)
     state = batch.init_state()
     chunk = max(int(sweep_every), 1)
-    mx = dict(alive=0, entries=0, npreds=0, vlen=0, mlen=0)
+    mx = dict(alive=0, entries=0, npreds=0, vlen=0, mlen=0, mchunk=0)
     for ev in _chunked(events, chunk):
         state, out = batch.scan(state, ev)
         mx["alive"] = max(mx["alive"], int(jnp.max(jnp.sum(state.alive, -1))))
@@ -111,7 +115,24 @@ def probe(
             int(jnp.max(state.vlen)),
             int(jnp.max(state.slab.pvlen)),
         )
-        mx["mlen"] = max(mx["mlen"], int(jnp.max(out.count)))
+        if config.lazy_extraction:
+            # Lazy configs emit through the drain pass: drain at chunk
+            # cadence (the processor's) and measure there instead.
+            state, dout = batch.drain(state)
+            mx["mlen"] = max(mx["mlen"], int(jnp.max(dout.count)))
+            mx["mchunk"] = max(
+                mx["mchunk"],
+                int(jnp.max(jnp.sum(dout.count > 0, axis=-1))),
+            )
+        else:
+            mx["mlen"] = max(mx["mlen"], int(jnp.max(out.count)))
+            # Completions per lane over this chunk — sum of completed
+            # match slots across the chunk's (t, r) grid, max over lanes:
+            # the lazy handle ring must hold one drain interval's worth.
+            mx["mchunk"] = max(
+                mx["mchunk"],
+                int(jnp.max(jnp.sum(out.count > 0, axis=(-2, -1)))),
+            )
         state = batch.sweep(state)
     return ProbeReport(
         counters=batch.counters(state),
@@ -120,6 +141,7 @@ def probe(
         max_npreds=mx["npreds"],
         max_vlen=mx["vlen"],
         max_match_len=mx["mlen"],
+        max_matches_chunk=mx["mchunk"],
         config=config,
     )
 
@@ -160,6 +182,7 @@ def suggest(tables, report: ProbeReport, margin: float = 1.5) -> EngineConfig:
         max_walk=max(
             tables.max_hops + 2, int(report.max_match_len * margin) + 2
         ),
+        handle_ring=suggest_handle_ring(report.max_matches_chunk, margin),
     )
 
 
@@ -178,6 +201,19 @@ def suggest_hot_entries(slab_entries: int, max_alive_runs: int) -> int:
         return 0
     e_hot = _round8(max(8, min(24, 2 * max_alive_runs)))
     return min(e_hot, slab_entries - 8)
+
+
+def suggest_handle_ring(max_matches_chunk: int, margin: float = 1.5) -> int:
+    """HB for a probed per-chunk completion maximum.
+
+    The ring holds every match completed between drains; the probe's
+    chunk cadence matches the processor's scan cadence (drain runs after
+    every scan), so the measured per-lane per-chunk completion maximum x
+    margin, rounded to the sublane tile, is the loss-free capacity.
+    Derived even for eager configs — the knob is inert there and a later
+    ``lazy_extraction=True`` flip inherits a sized ring.
+    """
+    return _round8(max(8, int(max_matches_chunk * margin)))
 
 
 def capacity_counters(counters: Dict[str, int]) -> Dict[str, int]:

@@ -77,3 +77,51 @@ def compact_matches(out, budget: int):
         n_hits,
         overflow,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("budget",))
+def compact_drained(dout, budget: int):
+    """``DrainOutput [K, HB, ...]`` -> globally compacted match rows.
+
+    The lazy-extraction analog of :func:`compact_matches`: the drain
+    pass's raw outputs are ``[K, HB, W]`` — ~100 MB per drain at
+    production lane counts, nearly all empty ring slots — so the hit
+    rows compact on-device into ``budget`` rows in (lane, ring) order
+    before the host pull.  Returns ``(stage [G, W], off [G, W],
+    count [G], seq [G], row [G], k [G], n_hits [], overflow [] bool)``;
+    same two-phase-pull contract as :func:`compact_matches` (overflow ⇒
+    the caller falls back to the full pull — correctness never depends
+    on the budget).
+    """
+    K, HB = dout.count.shape
+    W = dout.stage.shape[-1]
+    N = K * HB
+    G = min(budget, N)
+    i32 = jnp.int32
+
+    count = dout.count.reshape(N)
+    hit = count > 0
+    n_hits = jnp.sum(jnp.where(hit, 1, 0))
+    overflow = n_hits > G
+
+    rank = jnp.cumsum(jnp.where(hit, 1, 0)) - 1
+    dst = jnp.where(hit, rank, G).astype(i32)
+
+    def scat(flat, width=None):
+        if width is None:
+            z = jnp.zeros((G,), flat.dtype)
+            return z.at[dst].set(flat, mode="drop")
+        z = jnp.zeros((G, width), flat.dtype)
+        return z.at[dst].set(flat, mode="drop")
+
+    n = jnp.arange(N, dtype=i32)
+    return (
+        scat(dout.stage.reshape(N, W), W),
+        scat(dout.off.reshape(N, W), W),
+        scat(count),
+        scat(dout.seq.reshape(N)),
+        scat(dout.row.reshape(N)),
+        scat(n // HB),
+        n_hits,
+        overflow,
+    )

@@ -50,6 +50,7 @@ from kafkastreams_cep_tpu.engine.matcher import (
     StepOutput,
 )
 from kafkastreams_cep_tpu.ops.slab import SlabState
+from kafkastreams_cep_tpu.ops.walk_kernel import _coalesced_demote
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("ops.scan_kernel")
@@ -105,7 +106,13 @@ def build_scan(tables, config: EngineConfig):
     EH = cfg.slab_hot_entries
     EHk = EH if EH else E
     EO = E - EHk
-    N_OUT = 30  # kernel output refs (run state + slab + counters + emits)
+    # Lazy extraction (EngineConfig.lazy_extraction): completed matches
+    # append to the in-state handle ring (phase 6) instead of running
+    # extraction walkers in phase 4; the drain pass runs OUTSIDE this
+    # kernel (engine/matcher.py build_drain) at scan cadence.
+    LAZY = cfg.lazy_extraction
+    HB = cfg.handle_ring
+    N_OUT = 43  # kernel output refs (run state + slab + counters + ring + emits)
     H = tables.max_hops
     NS = max(tables.num_states, 1)
     S_CAND = 1 + H + 1
@@ -164,6 +171,10 @@ def build_scan(tables, config: EngineConfig):
         sstage, soff, srefs, snpreds, spstage, spoff, spvlen, spver,
         # counters
         run_drops, ver_ovf, fulld, predd, missing, trunc, hh, hm, ow, dm,
+        wh, eh, dh,
+        # lazy-extraction handle ring + step counter
+        hr_stage, hr_off, hr_vlen_i, hr_ts, hr_seq, hr_row, hr_ver,
+        hr_count, seq0, hovf,
         # per-t event slices
         ev_key, ev_ts, ev_off, ev_valid, *rest,
     ):
@@ -172,7 +183,9 @@ def build_scan(tables, config: EngineConfig):
         (o_alive, o_id, o_eval, o_vlen, o_event, o_start, o_branch, o_agg,
          o_ver, o_sstage, o_soff, o_srefs, o_snpreds, o_spstage, o_spoff,
          o_spvlen, o_spver, o_rd, o_vo, o_fd, o_pd, o_ms, o_tr,
-         o_hh, o_hm, o_ow, o_dm,
+         o_hh, o_hm, o_ow, o_dm, o_wh, o_eh, o_dh,
+         o_hrstage, o_hroff, o_hrvlen, o_hrts, o_hrseq, o_hrrow, o_hrver,
+         o_hrcount, o_seq, o_hovf,
          o_ostage, o_ooff, o_ocount) = rest[n_leaves:n_leaves + N_OUT]
         if EO:
             (sc_found, sc_refs, sc_np, sc_ps, sc_po, sc_pl, sc_pv) = rest[
@@ -210,6 +223,24 @@ def build_scan(tables, config: EngineConfig):
             o_hm[:] = hm[:]
             o_ow[:] = ow[:]
             o_dm[:] = dm[:]
+            o_wh[:] = wh[:]
+            o_eh[:] = eh[:]
+            o_dh[:] = dh[:]
+            o_hrstage[:] = hr_stage[:]
+            o_hroff[:] = hr_off[:]
+            o_hrvlen[:] = hr_vlen_i[:]
+            o_hrts[:] = hr_ts[:]
+            o_hrseq[:] = hr_seq[:]
+            o_hrrow[:] = hr_row[:]
+            o_hrver[:] = hr_ver[:]
+            o_hrcount[:] = hr_count[:]
+            o_hovf[:] = hovf[:]
+
+        # The per-lane step counter ticks every step (padding included) —
+        # it is the emission t-index, not match state.  seq_now is this
+        # step's stamp; the output carries the post-scan value.
+        seq_now = seq0[:] + t
+        o_seq[:] = seq_now + 1
 
         # Event blocks arrive [1, 1, L] ([T, 1, K] arrays — the middle 1
         # keeps the trailing dims tileable); squeeze the t axis.
@@ -447,6 +478,15 @@ def build_scan(tables, config: EngineConfig):
 
         p_rank = jnp.where(p_en, _cumsum0(p_en_i) - 1, -1)
         max_pn = jnp.max(jnp.sum(p_en_i, axis=0))
+        if EO:
+            # Coalesced demotion pre-pass (ops/walk_kernel.py): one move
+            # pass per step instead of one pl.when per put.
+            creator_c, crank_c, claim_c, kcap_c = _coalesced_demote(
+                (o_sstage, o_soff, o_srefs, o_snpreds, o_spstage, o_spoff,
+                 o_spvlen, o_spver, o_dm),
+                p_en, p_first_i != 0, p_cur, p_prev, prev_off_rep, off,
+                EHk=EHk, EO=EO, MP=MP, D=D,
+            )
 
         iota_e = jax.lax.broadcasted_iota(i32, (E, L), 0)
         iota_mp = jax.lax.broadcasted_iota(i32, (MP, L), 0)
@@ -455,7 +495,6 @@ def build_scan(tables, config: EngineConfig):
         iota_eh = jax.lax.broadcasted_iota(i32, (EHk, L), 0)
         iota_mp3h = jax.lax.broadcasted_iota(i32, (EHk, MP, L), 1)
         if EO:
-            iota_eo = jax.lax.broadcasted_iota(i32, (EO, L), 0)
             iota_mp3o = jax.lax.broadcasted_iota(i32, (EO, MP, L), 1)
 
         def put_body(b):
@@ -482,85 +521,27 @@ def build_scan(tables, config: EngineConfig):
 
             cur_hit = (o_sstage[:] == cur_s) & (o_soff[:] == off_l)
             exist = jnp.any(cur_hit, axis=0, keepdims=True)
-            free = o_sstage[:] < 0
-            # Two-tier allocation (ops/walk_kernel.py put phase): new
-            # entries land hot; hot-full demotes the min-off hot entry to
-            # a free overflow slot; drops only when the whole slab is full.
-            free_h = free[0:EHk]
-            ffs_h = jnp.min(
-                jnp.where(free_h, iota_eh, EHk), axis=0, keepdims=True
-            )
-            any_fh = ffs_h < EHk
+            # Two-tier allocation: demotions already ran in the coalesced
+            # pre-pass (ops/walk_kernel.py _coalesced_demote); allocation
+            # is a rank lookup into the claim map.  EO == 0 keeps the
+            # legacy first-free-slot scan verbatim.
             if EO:
-                free_o = free[EHk:]
-                ffs_o = jnp.min(
-                    jnp.where(free_o, iota_eo, EO), axis=0, keepdims=True
+                is_cr = jnp.any(
+                    pselm & creator_c, axis=0, keepdims=True
                 )
-                any_fo = ffs_o < EO
-                okey = jnp.where(
-                    ~free_h, o_soff[0:EHk], jnp.int32(1 << 30)
+                crk = ppick(crank_c)
+                alloc_h = (claim_c == crk) & is_cr
+                alloc = jnp.min(
+                    jnp.where(alloc_h, iota_eh, E), axis=0, keepdims=True
                 )
-                vkey = jnp.min(okey, axis=0, keepdims=True)
-                vslot = jnp.min(
-                    jnp.where(okey == vkey, iota_eh, EHk),
-                    axis=0, keepdims=True,
-                )
-                demote = en_ok & ~exist & ~any_fh & any_fo
-                o_dm[:] = o_dm[:] + jnp.where(demote, 1, 0)
-
-                @pl.when(jnp.any(demote))
-                def _():
-                    vm = (iota_eh == vslot) & demote  # [EHk, L]
-                    om = (iota_eo == ffs_o) & demote  # [EO, L]
-
-                    def mv2(ref):
-                        v = jnp.sum(
-                            jnp.where(vm, ref[0:EHk], 0),
-                            axis=0, keepdims=True,
-                        )
-                        ref[EHk:] = jnp.where(om, v, ref[EHk:])
-
-                    mv2(o_srefs)
-                    mv2(o_snpreds)
-
-                    def mv3(ref):
-                        v = jnp.sum(
-                            jnp.where(vm[:, None, :], ref[0:EHk], 0), axis=0
-                        )  # [MP, L]
-                        ref[EHk:] = jnp.where(
-                            om[:, None, :], v[None], ref[EHk:]
-                        )
-
-                    mv3(o_spstage)
-                    mv3(o_spoff)
-                    mv3(o_spvlen)
-                    v4 = jnp.sum(
-                        jnp.where(
-                            vm[None, :, None, :], o_spver[:, 0:EHk], 0
-                        ),
-                        axis=1,
-                    )  # [D, MP, L]
-                    o_spver[:, EHk:] = jnp.where(
-                        om[None, :, None, :], v4[:, None], o_spver[:, EHk:]
-                    )
-                    vstage = jnp.sum(
-                        jnp.where(vm, o_sstage[0:EHk], 0),
-                        axis=0, keepdims=True,
-                    )
-                    voff = jnp.sum(
-                        jnp.where(vm, o_soff[0:EHk], 0),
-                        axis=0, keepdims=True,
-                    )
-                    o_sstage[EHk:] = jnp.where(om, vstage, o_sstage[EHk:])
-                    o_soff[EHk:] = jnp.where(om, voff, o_soff[EHk:])
-                    o_sstage[0:EHk] = jnp.where(vm, -1, o_sstage[0:EHk])
-                    o_soff[0:EHk] = jnp.where(vm, -1, o_soff[0:EHk])
-
-                alloc = jnp.where(any_fh, ffs_h, vslot)
-                has_free = any_fh | any_fo
+                has_free = is_cr & (crk < kcap_c) & (alloc < E)
             else:
+                free_h = o_sstage[:] < 0
+                ffs_h = jnp.min(
+                    jnp.where(free_h, iota_eh, EHk), axis=0, keepdims=True
+                )
                 alloc = ffs_h
-                has_free = any_fh
+                has_free = ffs_h < EHk
             tgt = (exist & cur_hit) | (~exist & (iota_e == alloc))
             ok = en_ok & (exist | has_free)
             o_fd[:] = o_fd[:] + jnp.where(en_ok & ~exist & ~has_free, 1, 0)
@@ -609,10 +590,16 @@ def build_scan(tables, config: EngineConfig):
             return jnp.stack(frames[::-1], axis=2).reshape(D, RH, L)
 
         dead_en = dead & (o_event[:] >= 0)
+        # Lazy extraction: the final segment keeps its rows (static
+        # layout) but never enables — matches become ring handles in
+        # phase 6 instead of W-hop extraction walkers here.
+        final_w = (
+            jnp.zeros((R, L), i32) if LAZY else jnp.where(final_en, 1, 0)
+        )
         w_en_i = jnp.concatenate([
             rev_rh([jnp.where(m, 1, 0) for m in br_en]),
             jnp.where(dead_en, 1, 0),
-            jnp.where(final_en, 1, 0),
+            final_w,
         ])
         w_en = w_en_i != 0
         w_rem_i = jnp.concatenate(
@@ -671,6 +658,15 @@ def build_scan(tables, config: EngineConfig):
             def hop_body(c):
                 h, active_i, cs, co, qv, ql, cnt, st_stage, st_off = c
                 hactive = active_i != 0
+                # Walk-cost accounting (ops/slab.py _hop_counts); the
+                # drain pass never runs in-kernel, so the emit class is
+                # always the eager extraction counter.
+                o_wh[:] = o_wh[:] + jnp.where(
+                    hactive & (wot_i == 0), 1, 0
+                )
+                o_eh[:] = o_eh[:] + jnp.where(
+                    hactive & (wot_i != 0), 1, 0
+                )
                 # Hot-tier lookup first (ops/walk_kernel.py hop): the
                 # overflow rows are touched only when some lane of the
                 # block missed hot.
@@ -1017,6 +1013,53 @@ def build_scan(tables, config: EngineConfig):
         o_ooff[:] = jnp.where(valid[None, :, None, :], o_ooff[:], -1)
         o_ocount[:] = jnp.where(valid[None], o_ocount[:], 0)
 
+        # ---- phase 6 (lazy only): handle-ring append + root pin — the
+        # in-kernel port of matcher.finish's lazy branch.  Completed
+        # matches take consecutive ring slots in run-queue order; each
+        # appended handle pins its root entry (refs +1) so no later
+        # removal walk can delete the chain root before the out-of-kernel
+        # drain pass unpins it.  Ring-full matches are dropped and
+        # counted (handle_overflows — the loss-free contract's counter).
+        if LAZY:
+            fin_i = jnp.where(final_en, 1, 0)  # [R, L]
+            frank = _cumsum0(fin_i) - 1
+            dst = o_hrcount[:] + frank  # [R, L]
+            fit = final_en & (dst < HB)
+            iota_hb3 = jax.lax.broadcasted_iota(i32, (R, HB, L), 1)
+            m3h = fit[:, None, :] & (iota_hb3 == dst[:, None, :])
+            got = jnp.any(m3h, axis=0)  # [HB, L]
+
+            def ring2(val_rl):  # [R, L] -> [HB, L] (masked pick)
+                return jnp.sum(jnp.where(m3h, val_rl[:, None, :], 0), axis=0)
+
+            o_hrstage[:] = jnp.where(got, ring2(surv_id), o_hrstage[:])
+            o_hroff[:] = jnp.where(got, off, o_hroff[:])
+            o_hrvlen[:] = jnp.where(got, ring2(surv_vlen), o_hrvlen[:])
+            o_hrts[:] = jnp.where(got, ts, o_hrts[:])
+            o_hrseq[:] = jnp.where(got, seq_now, o_hrseq[:])
+            iota_r = jax.lax.broadcasted_iota(i32, (R, L), 0)
+            o_hrrow[:] = jnp.where(got, ring2(iota_r), o_hrrow[:])
+            for k in range(D):
+                o_hrver[k] = jnp.where(
+                    got, ring2(surv_ver[k]), o_hrver[k]
+                )
+            o_hrcount[:] = o_hrcount[:] + jnp.sum(
+                jnp.where(fit, 1, 0), axis=0, keepdims=True
+            )
+            o_hovf[:] = o_hovf[:] + jnp.sum(
+                jnp.where(final_en & ~fit, 1, 0), axis=0, keepdims=True
+            )
+            pin = jnp.sum(
+                jnp.where(
+                    (o_sstage[:][None, :, :] == surv_id[:, None, :])
+                    & (o_soff[:][None, :, :] == off[None])
+                    & fit[:, None, :],
+                    1, 0,
+                ),
+                axis=0,
+            )  # [E, L]
+            o_srefs[:] = o_srefs[:] + pin
+
     # ------------------------------------------------------------------
     # Host-side wrapper: layouts, specs, and the jitted entry point.
     # ------------------------------------------------------------------
@@ -1069,6 +1112,19 @@ def build_scan(tables, config: EngineConfig):
             row(state.slab.hot_misses),
             row(state.slab.overflow_walks),
             row(state.slab.demotions),
+            row(state.slab.walk_hops),
+            row(state.slab.extract_hops),
+            row(state.slab.drain_hops),
+            tin(state.hr_stage),
+            tin(state.hr_off),
+            tin(state.hr_vlen),
+            tin(state.hr_ts),
+            tin(state.hr_seq),
+            tin(state.hr_row),
+            jnp.transpose(state.hr_ver, (2, 1, 0)),  # [D, HB, K]
+            row(state.hr_count),
+            row(state.step_seq),
+            row(state.handle_overflows),
             tev(jnp.asarray(events.key, jnp.int32)),
             tev(jnp.asarray(events.ts, jnp.int32)),
             tev(jnp.asarray(events.off, jnp.int32)),
@@ -1102,7 +1158,7 @@ def build_scan(tables, config: EngineConfig):
                 memory_space=pltpu.VMEM,
             )
 
-        n_state = 27
+        n_state = 40
         in_specs = (
             [state_spec(tuple(x.shape)) for x in ins[:n_state]]
             + [ev_spec(tuple(x.shape)) for x in ins[n_state:]]
@@ -1140,6 +1196,19 @@ def build_scan(tables, config: EngineConfig):
             jax.ShapeDtypeStruct((1, K), i32),  # hot_misses
             jax.ShapeDtypeStruct((1, K), i32),  # overflow_walks
             jax.ShapeDtypeStruct((1, K), i32),  # demotions
+            jax.ShapeDtypeStruct((1, K), i32),  # walk_hops
+            jax.ShapeDtypeStruct((1, K), i32),  # extract_hops
+            jax.ShapeDtypeStruct((1, K), i32),  # drain_hops
+            jax.ShapeDtypeStruct((HB, K), i32),  # hr_stage
+            jax.ShapeDtypeStruct((HB, K), i32),  # hr_off
+            jax.ShapeDtypeStruct((HB, K), i32),  # hr_vlen
+            jax.ShapeDtypeStruct((HB, K), i32),  # hr_ts
+            jax.ShapeDtypeStruct((HB, K), i32),  # hr_seq
+            jax.ShapeDtypeStruct((HB, K), i32),  # hr_row
+            jax.ShapeDtypeStruct((D, HB, K), i32),  # hr_ver
+            jax.ShapeDtypeStruct((1, K), i32),  # hr_count
+            jax.ShapeDtypeStruct((1, K), i32),  # step_seq
+            jax.ShapeDtypeStruct((1, K), i32),  # handle_overflows
             jax.ShapeDtypeStruct((T, R, W, K), i32),  # out stage
             jax.ShapeDtypeStruct((T, R, W, K), i32),  # out off
             jax.ShapeDtypeStruct((T, R, K), i32),  # out count
@@ -1179,7 +1248,9 @@ def build_scan(tables, config: EngineConfig):
         (n_alive, n_id, n_eval, n_vlen, n_event, n_start, n_branch, n_agg,
          n_ver, n_sstage, n_soff, n_srefs, n_snpreds, n_spstage, n_spoff,
          n_spvlen, n_spver, n_rd, n_vo, n_fd, n_pd, n_ms, n_tr,
-         n_hh, n_hm, n_ow, n_dm,
+         n_hh, n_hm, n_ow, n_dm, n_wh, n_eh, n_dh,
+         n_hrstage, n_hroff, n_hrvlen, n_hrts, n_hrseq, n_hrrow, n_hrver,
+         n_hrcount, n_seq, n_hovf,
          o_stage, o_off, o_count) = outs
 
         unrow = lambda x: x[0]
@@ -1211,9 +1282,22 @@ def build_scan(tables, config: EngineConfig):
                 hot_misses=unrow(n_hm),
                 overflow_walks=unrow(n_ow),
                 demotions=unrow(n_dm),
+                walk_hops=unrow(n_wh),
+                extract_hops=unrow(n_eh),
+                drain_hops=unrow(n_dh),
             ),
             run_drops=unrow(n_rd),
             ver_overflows=unrow(n_vo),
+            hr_stage=tout(n_hrstage),
+            hr_off=tout(n_hroff),
+            hr_ver=jnp.transpose(n_hrver, (2, 1, 0)),
+            hr_vlen=tout(n_hrvlen),
+            hr_ts=tout(n_hrts),
+            hr_seq=tout(n_hrseq),
+            hr_row=tout(n_hrrow),
+            hr_count=unrow(n_hrcount),
+            step_seq=unrow(n_seq),
+            handle_overflows=unrow(n_hovf),
         )
         out = StepOutput(
             stage=jnp.transpose(o_stage, (3, 0, 1, 2)),  # [K, T, R, W]

@@ -82,6 +82,13 @@ class SlabState(NamedTuple):
     hot_misses: jnp.ndarray  # scalar int32 — walk hops not resolved hot
     overflow_walks: jnp.ndarray  # scalar int32 — walk hops resolved overflow
     demotions: jnp.ndarray  # scalar int32 — hot -> overflow entry moves
+    # --- walk-cost telemetry (never loss indicators): every active hop of
+    #     every walker is classified exactly once by walker class, so the
+    #     reduce-width perf model (PROFILE_r05/r06: per-hop masked reduces x
+    #     lockstep trip counts) is measurable on CPU CI without a chip.
+    walk_hops: jnp.ndarray  # scalar int32 — branch/dead-removal walker hops
+    extract_hops: jnp.ndarray  # scalar int32 — eager in-step extraction hops
+    drain_hops: jnp.ndarray  # scalar int32 — deferred drain-pass hops (lazy)
 
 
 def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
@@ -105,6 +112,9 @@ def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
         hot_misses=jnp.zeros((), dtype=i32),
         overflow_walks=jnp.zeros((), dtype=i32),
         demotions=jnp.zeros((), dtype=i32),
+        walk_hops=jnp.zeros((), dtype=i32),
+        extract_hops=jnp.zeros((), dtype=i32),
+        drain_hops=jnp.zeros((), dtype=i32),
     )
 
 
@@ -210,6 +220,34 @@ def _tier_counts(slab: SlabState, active, found_hot, found):
         overflow_walks=slab.overflow_walks
         + jnp.sum((active & ~found_hot & found).astype(i32)),
     )
+
+
+def _hop_counts(slab: SlabState, active, want_out=None, kind: str = "walk"):
+    """Classify one hop's active walkers into the walk-cost counters.
+
+    ``want_out`` (when given) splits the pool: emitting walkers count to
+    the ``kind`` class ("extract" eager in-step, "drain" deferred pass),
+    non-emitting walkers to ``walk_hops``.  Without it, every active
+    walker counts to ``kind``.  Static ``kind`` keeps the counter choice
+    trace-time, mirroring the Pallas kernels' static routing.
+    """
+    i32 = jnp.int32
+    if want_out is None:
+        n_emit = jnp.sum(jnp.asarray(active).astype(i32))
+        n_walk = jnp.zeros((), i32)
+    else:
+        n_emit = jnp.sum((active & want_out).astype(i32))
+        n_walk = jnp.sum((active & ~want_out).astype(i32))
+    upd = {"walk_hops": slab.walk_hops + n_walk}
+    if kind == "walk":
+        upd["walk_hops"] = upd["walk_hops"] + n_emit
+    elif kind == "extract":
+        upd["extract_hops"] = slab.extract_hops + n_emit
+    elif kind == "drain":
+        upd["drain_hops"] = slab.drain_hops + n_emit
+    else:  # pragma: no cover - trace-time misuse
+        raise ValueError(f"unknown hop kind {kind!r}")
+    return slab._replace(**upd)
 
 
 def _select_pointer(slab: SlabState, e, qver, qlen):
@@ -327,6 +365,7 @@ def branch(slab: SlabState, stage, off, ver, vlen, max_walk: int, enable=True, h
             slab = _tier_counts(
                 slab, active, found & (e < hot_entries), found
             )
+        slab = _hop_counts(slab, active)
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
         slab = slab._replace(
@@ -366,6 +405,7 @@ def peek(
     remove: bool,
     enable=True,
     hot_entries: int = 0,
+    hop_kind: str = "extract",
 ):
     """Backward pointer walk assembling a match, final stage first.
 
@@ -388,6 +428,7 @@ def peek(
             slab = _tier_counts(
                 slab, active, found & (e < hot_entries), found
             )
+        slab = _hop_counts(slab, active, kind=hop_kind)
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
         m1 = _oh(e, E) & active
@@ -527,6 +568,7 @@ def walks_batched(
     max_walk: int,
     collect: bool = True,
     hot_entries: int = 0,
+    drain: bool = False,
 ):
     """ALL of one step's buffer walks — branch refcount walks, dead-run
     removals, and final-match extractions — in a single lockstep pass.
@@ -583,6 +625,9 @@ def walks_batched(
             slab = _tier_counts(
                 slab, active, jnp.any(hit[:, :hot_entries], axis=1), found
             )
+        slab = _hop_counts(
+            slab, active, want_out, kind="drain" if drain else "extract"
+        )
         slab = slab._replace(
             missing=slab.missing + jnp.sum((active & ~found).astype(i32))
         )
@@ -997,6 +1042,7 @@ def branch_batched(
             slab = _tier_counts(
                 slab, active, jnp.any(hit[:, :hot_entries], axis=1), found
             )
+        slab = _hop_counts(slab, active)
         slab = slab._replace(
             missing=slab.missing + jnp.sum((active & ~found).astype(i32))
         )
@@ -1059,6 +1105,7 @@ def walks_compacted(
     out_base: int,
     out_rows: int,
     hot_entries: int = 0,
+    drain: bool = False,
 ):
     """The step's walk pass over a *small* compacted walker pool.
 
@@ -1133,6 +1180,7 @@ def walks_compacted(
             gather(want_out),
             W,
             hot_entries=hot_entries,
+            drain=drain,
         )
         # Scatter served output walkers back to their final-segment rows.
         oho = ohc[out_base:out_base + out_rows]  # [out_rows, B]
@@ -1166,6 +1214,7 @@ def peek_batched(
     max_walk: int,
     remove: bool,
     hot_entries: int = 0,
+    drain: bool = False,
 ):
     """Lockstep removal walks — a thin wrapper over :func:`walks_batched`
     with every walker removing and emitting (``remove=False`` keeps the
@@ -1178,7 +1227,7 @@ def peek_batched(
     return walks_batched(
         slab, en, stage, off, ver, vlen,
         is_remove=ones, want_out=ones, max_walk=max_walk, collect=remove,
-        hot_entries=hot_entries,
+        hot_entries=hot_entries, drain=drain,
     )
 
 
@@ -1190,4 +1239,6 @@ def peek_batched(
 put_first = jax.jit(put_first, static_argnames=("hot_entries",))
 put = jax.jit(put, static_argnames=("hot_entries",))
 branch = jax.jit(branch, static_argnames=("max_walk", "hot_entries"))
-peek = jax.jit(peek, static_argnames=("max_walk", "remove", "hot_entries"))
+peek = jax.jit(
+    peek, static_argnames=("max_walk", "remove", "hot_entries", "hop_kind")
+)

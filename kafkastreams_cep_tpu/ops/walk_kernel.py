@@ -48,19 +48,176 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
 )
 
 
+def _cumsum0(x):
+    """Inclusive prefix sum along axis 0 via log-shift adds — Mosaic has
+    no cumsum lowering; log2(N) shifted adds of the [N, L] plane do."""
+    n = x.shape[0]
+    k = 1
+    while k < n:
+        pad = jnp.zeros((k,) + x.shape[1:], x.dtype)
+        x = x + jnp.concatenate([pad, x[:-k]], axis=0)
+        k *= 2
+    return x
+
+
+def _coalesced_demote(
+    refs, p_en, p_first, p_cur, p_pst, p_pof, off_l,
+    EHk: int, EO: int, MP: int, D: int,
+):
+    """One pass serving ALL of a step's hot→overflow demotions, plus the
+    per-creation hot-slot claim map the put loop allocates from —
+    replacing the per-put ``pl.when`` demotion (PROFILE_r06 "next
+    leverage" item 2: hot-tier thrash at E_hot ≪ live entries paid one
+    masked move pass per put).
+
+    Sequential-equivalence argument: within one step every put targets
+    the current event, so (a) predecessor lookups (strictly older events)
+    and target-existence groups are fixed at step start, (b) each target
+    group's FIRST enabled op is the only creator, (c) creations consume
+    free hot slots in ascending index order (the sequential allocator's
+    lowest-index-free rule) and then demote victims in ascending
+    (event offset, index) order (its min-off rule — entries created this
+    step carry the current, maximal offset, so victims always come from
+    the step-start occupancy while E_hot ≥ the pattern's consuming-stage
+    count, which the E_hot ≥ 8 floor guarantees for every compiled
+    pattern here), with victim ``d`` landing in the ``d``-th free
+    overflow slot.  All of that is computable up front, so the moves
+    coalesce into one pass and the loop's allocation becomes a rank
+    lookup.  Bit-exact parity with the per-op jnp path is pinned by
+    ``tests/test_two_tier.py``.
+
+    ``refs`` is ``(stage, off, refs, npreds, pstage, poff, pvlen, pver,
+    dm)`` output refs (pver laid out ``[D, E, MP, L]``); ``p_*`` are the
+    step's put-op planes ``[PP, L]`` (values, lane-last).  Returns
+    ``(creator [PP, L] bool, crank [PP, L], claim [EHk, L], k_cap
+    [1, L])``: creation-rank ``c`` allocates the slot with ``claim == c``
+    and drops iff ``c >= k_cap``.
+    """
+    (o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
+     o_dm) = refs
+    i32 = jnp.int32
+    PP, L = p_cur.shape
+    E = EHk + EO
+    st0 = o_stage[:]
+    of0 = o_off[:]
+
+    # Per-op enablement and target existence, fixed at step start (puts
+    # never delete; predecessors and targets cannot collide in-step).
+    prev_found = jnp.any(
+        (st0[None] == p_pst[:, None, :]) & (of0[None] == p_pof[:, None, :]),
+        axis=1,
+    )  # [PP, L]
+    en_ok = p_en & (p_first | prev_found)
+    exist0 = jnp.any(
+        (st0[None] == p_cur[:, None, :]) & (of0[None] == off_l[None]),
+        axis=1,
+    )
+
+    # Group (same target stage) first-enabled op = the creator.
+    iota_p0 = jax.lax.broadcasted_iota(i32, (PP, PP, L), 0)
+    iota_p1 = jax.lax.broadcasted_iota(i32, (PP, PP, L), 1)
+    same = p_cur[None, :, :] == p_cur[:, None, :]
+    earlier_en = same & (iota_p1 < iota_p0) & en_ok[None, :, :]
+    creator = en_ok & ~jnp.any(earlier_en, axis=1) & ~exist0
+    creator_i = jnp.where(creator, 1, 0)
+    crank = _cumsum0(creator_i) - creator_i  # exclusive: creation rank
+    n_create = jnp.sum(creator_i, axis=0, keepdims=True)  # [1, L]
+
+    # Free-slot ranks and demotion victims.
+    iota_eh = jax.lax.broadcasted_iota(i32, (EHk, L), 0)
+    free_h = st0[0:EHk] < 0
+    free_h_i = jnp.where(free_h, 1, 0)
+    frank = _cumsum0(free_h_i) - free_h_i
+    n_free_hot = jnp.sum(free_h_i, axis=0, keepdims=True)
+    occ_h = ~free_h
+    n_occ = jnp.sum(jnp.where(occ_h, 1, 0), axis=0, keepdims=True)
+    iota_eo = jax.lax.broadcasted_iota(i32, (EO, L), 0)
+    free_o = st0[EHk:] < 0
+    free_o_i = jnp.where(free_o, 1, 0)
+    orank = _cumsum0(free_o_i) - free_o_i
+    n_free_ov = jnp.sum(free_o_i, axis=0, keepdims=True)
+    k_cap = n_free_hot + n_free_ov
+
+    # Victim rank: ascending (offset, index) among step-start occupied.
+    of_h = of0[0:EHk]
+    iota_a = jax.lax.broadcasted_iota(i32, (EHk, EHk, L), 0)
+    iota_b = jax.lax.broadcasted_iota(i32, (EHk, EHk, L), 1)
+    less = (of_h[None, :, :] < of_h[:, None, :]) | (
+        (of_h[None, :, :] == of_h[:, None, :]) & (iota_b < iota_a)
+    )
+    vrank = jnp.sum(
+        jnp.where(less & occ_h[None, :, :], 1, 0), axis=1
+    )  # [EHk, L]
+
+    n_demote = jnp.clip(
+        n_create - n_free_hot, 0, jnp.minimum(n_free_ov, n_occ)
+    )
+    o_dm[:] = o_dm[:] + n_demote
+    is_victim = occ_h & (vrank < n_demote)
+
+    @pl.when(jnp.any(is_victim))
+    def _():
+        # Victim d -> d-th free overflow slot, ALL moves in one pass.
+        mv = (
+            is_victim[:, None, :]
+            & free_o[None, :, :]
+            & (vrank[:, None, :] == orank[None, :, :])
+        )  # [EHk, EO, L]
+        anym = jnp.any(mv, axis=0)  # [EO, L]
+
+        def mv2(ref):
+            v = jnp.sum(jnp.where(mv, ref[0:EHk][:, None, :], 0), axis=0)
+            ref[EHk:] = jnp.where(anym, v, ref[EHk:])
+
+        mv2(o_refs)
+        mv2(o_npreds)
+
+        def mv3(ref):
+            v = jnp.sum(
+                jnp.where(mv[:, :, None, :], ref[0:EHk][:, None], 0),
+                axis=0,
+            )  # [EO, MP, L]
+            ref[EHk:] = jnp.where(anym[:, None, :], v, ref[EHk:])
+
+        mv3(o_pstage)
+        mv3(o_poff)
+        mv3(o_pvlen)
+        for d in range(D):
+            v = jnp.sum(
+                jnp.where(mv[:, :, None, :], o_pver[d, 0:EHk][:, None], 0),
+                axis=0,
+            )
+            o_pver[d, EHk:] = jnp.where(anym[:, None, :], v, o_pver[d, EHk:])
+        vst = jnp.sum(jnp.where(mv, o_stage[0:EHk][:, None, :], 0), axis=0)
+        vof = jnp.sum(jnp.where(mv, o_off[0:EHk][:, None, :], 0), axis=0)
+        o_stage[EHk:] = jnp.where(anym, vst, o_stage[EHk:])
+        o_off[EHk:] = jnp.where(anym, vof, o_off[EHk:])
+        o_stage[0:EHk] = jnp.where(is_victim, -1, o_stage[0:EHk])
+        o_off[0:EHk] = jnp.where(is_victim, -1, o_off[0:EHk])
+
+    # Claim map: creation rank c takes the c-th free hot slot (ascending
+    # index), then victims in vrank order.
+    BIG = jnp.int32(PP + E + 1)
+    claim = jnp.where(free_h, frank, BIG)
+    claim = jnp.where(is_victim, n_free_hot + vrank, claim)
+    return creator, crank, claim, k_cap
+
+
 def _kernel(
     # inputs (lane-last blocks)
     stage, off, refs, npreds, pstage, poff, pvlen, pver, missing, trunc,
-    fulld, predd, hh, hm, ow, dm,
+    fulld, predd, hh, hm, ow, dm, wh, eh, dh,
     p_first, p_cur, p_pstage, p_poff, p_vlen, p_ver, p_rank, p_nen, ev_off,
     en, wstage, woff, wvlen, wver, wrem, wout, rank, nen,
     # outputs
     o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
     o_missing, o_trunc, o_fulld, o_predd, o_hh, o_hm, o_ow, o_dm,
+    o_wh, o_eh, o_dh,
     o_ostage, o_ooff, o_count,
     # scratch (tier_scratch is empty unless EH > 0)
     st_stage, st_off, *tier_scratch,
     W: int, out_base: int, out_rows: int, with_puts: bool, EH: int,
+    drain: bool,
 ):
     E, MP, L = pstage.shape
     # pver blocks arrive [D, E, MP, L]: the tiled trailing dims are then
@@ -98,6 +255,9 @@ def _kernel(
     o_hm[:] = hm[:]
     o_ow[:] = ow[:]
     o_dm[:] = dm[:]
+    o_wh[:] = wh[:]
+    o_eh[:] = eh[:]
+    o_dh[:] = dh[:]
     o_ostage[:] = jnp.full((OR, W, L), -1, i32)
     o_ooff[:] = jnp.full((OR, W, L), -1, i32)
     o_count[:] = jnp.zeros((OR, L), i32)
@@ -112,7 +272,6 @@ def _kernel(
     iota_or2 = jax.lax.broadcasted_iota(i32, (OR, L), 0)
     iota_eh = jax.lax.broadcasted_iota(i32, (EHk, L), 0)
     if EO:
-        iota_eo = jax.lax.broadcasted_iota(i32, (EO, L), 0)
         iota_mp3o = jax.lax.broadcasted_iota(i32, (EO, MP, L), 1)
 
     # ---- consuming-put phase (reference order precedes all walks; one
@@ -121,6 +280,17 @@ def _kernel(
     if with_puts:
         iota_e = jax.lax.broadcasted_iota(i32, (E, L), 0)
         max_pn = jnp.max(p_nen[0, :])
+        if EO:
+            # Coalesced demotion pre-pass: ALL of the step's hot→overflow
+            # demotions in one move pass (not one pl.when per put), plus
+            # the claim map the loop's allocation reads.
+            creator_c, crank_c, claim_c, kcap_c = _coalesced_demote(
+                (o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff,
+                 o_pvlen, o_pver, o_dm),
+                p_rank[:] >= 0, p_first[:] != 0, p_cur[:],
+                p_pstage[:], p_poff[:], ev_off[:],
+                EHk=EHk, EO=EO, MP=MP, D=D,
+            )
 
         def put_body(b):
             pselm = p_rank[:] == b  # [PP, L] — at most one True per lane
@@ -152,87 +322,33 @@ def _kernel(
 
             cur_hit = (o_stage[:] == cur) & (o_off[:] == off_l)  # [E, L]
             exist = jnp.any(cur_hit, axis=0, keepdims=True)
-            free = o_stage[:] < 0
-            # Two-tier allocation: new entries always land hot — a free hot
-            # slot, else the least-recent (min off, lowest index) hot entry
-            # demotes into a free overflow slot and frees its own.  Drops
-            # happen only when the WHOLE slab is full, exactly the single-
-            # tier condition (EO == 0 makes this the legacy path verbatim).
-            free_h = free[0:EHk]
-            ffs_h = jnp.min(
-                jnp.where(free_h, iota_eh, EHk), axis=0, keepdims=True
-            )
-            any_fh = ffs_h < EHk
+            # Two-tier allocation: demotions already ran in the coalesced
+            # pre-pass, so allocation is a rank lookup into the claim map
+            # (creation rank c -> the slot claiming c; c >= k_cap drops —
+            # exactly the whole-slab-full condition).  EO == 0 keeps the
+            # legacy first-free-slot scan verbatim.
             if EO:
-                free_o = free[EHk:]
-                ffs_o = jnp.min(
-                    jnp.where(free_o, iota_eo, EO), axis=0, keepdims=True
+                is_cr = jnp.any(
+                    pselm & creator_c, axis=0, keepdims=True
+                )  # [1, L] — this batch's op is its group's creator
+                crk = ppick(crank_c)
+                alloc_h = (claim_c == crk) & is_cr  # [EHk, L], <=1 True
+                alloc = jnp.min(
+                    jnp.where(alloc_h, iota_eh, E), axis=0, keepdims=True
                 )
-                any_fo = ffs_o < EO
-                okey = jnp.where(
-                    ~free_h, o_off[0:EHk], jnp.int32(1 << 30)
-                )
-                vkey = jnp.min(okey, axis=0, keepdims=True)
-                vslot = jnp.min(
-                    jnp.where(okey == vkey, iota_eh, EHk),
-                    axis=0, keepdims=True,
-                )
-                demote = en_ok & ~exist & ~any_fh & any_fo
-                o_dm[:] = o_dm[:] + jnp.where(demote, 1, 0)
-
-                @pl.when(jnp.any(demote))
-                def _():
-                    vm = (iota_eh == vslot) & demote  # [EHk, L]
-                    om = (iota_eo == ffs_o) & demote  # [EO, L]
-
-                    def mv2(ref):
-                        v = jnp.sum(
-                            jnp.where(vm, ref[0:EHk], 0),
-                            axis=0, keepdims=True,
-                        )
-                        ref[EHk:] = jnp.where(om, v, ref[EHk:])
-
-                    mv2(o_refs)
-                    mv2(o_npreds)
-
-                    def mv3(ref):
-                        v = jnp.sum(
-                            jnp.where(vm[:, None, :], ref[0:EHk], 0), axis=0
-                        )  # [MP, L]
-                        ref[EHk:] = jnp.where(
-                            om[:, None, :], v[None], ref[EHk:]
-                        )
-
-                    mv3(o_pstage)
-                    mv3(o_poff)
-                    mv3(o_pvlen)
-                    v4 = jnp.sum(
-                        jnp.where(
-                            vm[None, :, None, :], o_pver[:, 0:EHk], 0
-                        ),
-                        axis=1,
-                    )  # [D, MP, L]
-                    o_pver[:, EHk:] = jnp.where(
-                        om[None, :, None, :], v4[:, None], o_pver[:, EHk:]
-                    )
-                    vstage = jnp.sum(
-                        jnp.where(vm, o_stage[0:EHk], 0),
-                        axis=0, keepdims=True,
-                    )
-                    voff = jnp.sum(
-                        jnp.where(vm, o_off[0:EHk], 0),
-                        axis=0, keepdims=True,
-                    )
-                    o_stage[EHk:] = jnp.where(om, vstage, o_stage[EHk:])
-                    o_off[EHk:] = jnp.where(om, voff, o_off[EHk:])
-                    o_stage[0:EHk] = jnp.where(vm, -1, o_stage[0:EHk])
-                    o_off[0:EHk] = jnp.where(vm, -1, o_off[0:EHk])
-
-                alloc = jnp.where(any_fh, ffs_h, vslot)
-                has_free = any_fh | any_fo
+                # alloc < E guard: a creation past the start-occupied
+                # victim pool would claim nothing (unreachable while
+                # E_hot >= the pattern's consuming-stage count — the
+                # E_hot >= 8 floor); the guard turns it into a counted
+                # drop instead of a silent no-op write.
+                has_free = is_cr & (crk < kcap_c) & (alloc < E)
             else:
+                free_h = o_stage[:] < 0
+                ffs_h = jnp.min(
+                    jnp.where(free_h, iota_eh, EHk), axis=0, keepdims=True
+                )
                 alloc = ffs_h
-                has_free = any_fh
+                has_free = ffs_h < EHk
             # Boolean algebra, not where(): Mosaic can't select i1 vectors.
             tgt = (exist & cur_hit) | (~exist & (iota_e == alloc))  # [E, L]
             ok = en_ok & (exist | has_free)
@@ -306,6 +422,15 @@ def _kernel(
         def hop_body(c):
             h, active_i, cs, co, qv, ql, cnt = c
             active = active_i != 0
+            # Walk-cost accounting (ops/slab.py _hop_counts): every active
+            # walker's hop classified once, by walker class; the emit
+            # class is static (drain pass vs eager extraction).
+            emit_hop = jnp.where(active & wot, 1, 0)
+            o_wh[:] = o_wh[:] + jnp.where(active & ~wot, 1, 0)
+            if drain:
+                o_dh[:] = o_dh[:] + emit_hop
+            else:
+                o_eh[:] = o_eh[:] + emit_hop
             # Hot-tier lookup first: [EHk, L] compares instead of [E, L].
             # The overflow rows are consulted only when some lane of the
             # block missed hot — the common all-hot hop never touches them
@@ -582,6 +707,7 @@ def _from_lane_last(x):
     jax.jit,
     static_argnames=(
         "max_walk", "out_base", "out_rows", "interpret", "hot_entries",
+        "drain",
     ),
 )
 def walk_pass_kernel(
@@ -600,6 +726,7 @@ def walk_pass_kernel(
     put_ops=None,
     ev_off=None,
     hot_entries: int = 0,
+    drain: bool = False,
 ) -> Tuple[SlabState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The step's walk pass for a ``[K]``-batched slab via the fused kernel.
 
@@ -686,6 +813,9 @@ def walk_pass_kernel(
         row(slab.hot_misses),
         row(slab.overflow_walks),
         row(slab.demotions),
+        row(slab.walk_hops),
+        row(slab.extract_hops),
+        row(slab.drain_hops),
         *put_ins,
         tin(en_i),
         tin(jnp.asarray(stage, i32)),
@@ -728,6 +858,9 @@ def walk_pass_kernel(
         jax.ShapeDtypeStruct((1, K), i32),  # hot_misses
         jax.ShapeDtypeStruct((1, K), i32),  # overflow_walks
         jax.ShapeDtypeStruct((1, K), i32),  # demotions
+        jax.ShapeDtypeStruct((1, K), i32),  # walk_hops
+        jax.ShapeDtypeStruct((1, K), i32),  # extract_hops
+        jax.ShapeDtypeStruct((1, K), i32),  # drain_hops
         jax.ShapeDtypeStruct((OR, W, K), i32),  # out_stage
         jax.ShapeDtypeStruct((OR, W, K), i32),  # out_off
         jax.ShapeDtypeStruct((OR, K), i32),  # count
@@ -754,7 +887,7 @@ def walk_pass_kernel(
     outs = pl.pallas_call(
         functools.partial(
             _kernel, W=W, out_base=out_base, out_rows=out_rows,
-            with_puts=with_puts, EH=hot_entries,
+            with_puts=with_puts, EH=hot_entries, drain=drain,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -769,6 +902,7 @@ def walk_pass_kernel(
 
     (n_stage, n_off, n_refs, n_npreds, n_pstage, n_poff, n_pvlen, n_pver,
      n_missing, n_trunc, n_fulld, n_predd, n_hh, n_hm, n_ow, n_dm,
+     n_wh, n_eh, n_dh,
      o_stage, o_off, o_count) = outs
     new_slab = slab._replace(
         stage=tout(n_stage),
@@ -787,6 +921,9 @@ def walk_pass_kernel(
         hot_misses=unrow(n_hm),
         overflow_walks=unrow(n_ow),
         demotions=unrow(n_dm),
+        walk_hops=unrow(n_wh),
+        extract_hops=unrow(n_eh),
+        drain_hops=unrow(n_dh),
     )
     return (
         new_slab,

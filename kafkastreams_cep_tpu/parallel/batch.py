@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from kafkastreams_cep_tpu.engine.matcher import (
     COUNTER_NAMES,
     HOT_COUNTER_NAMES,
+    WALK_COUNTER_NAMES,
+    DrainOutput,
     EngineConfig,
     EngineState,
     EventBatch,
@@ -25,6 +27,7 @@ from kafkastreams_cep_tpu.engine.matcher import (
     TPUMatcher,
     counter_values,
     hot_counter_values,
+    walk_counter_values,
 )
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
@@ -174,20 +177,53 @@ def sweep_lanes(state: EngineState, depth: int, do_renorm: bool) -> EngineState:
     never diverge): slab mark-sweep (frees entries unreachable from live
     run state) then, when enabled, Dewey version renormalization
     (``ops/renorm.py`` — deletes provably-dead zero positions so the fixed
-    ``dewey_depth`` stays sufficient on unbounded straddling streams)."""
+    ``dewey_depth`` stays sufficient on unbounded straddling streams).
+
+    Pending lazy-extraction handles (``EngineState.hr_*``) are first-class
+    liveness roots: a pinned-but-undrained match chain must survive the
+    mark-sweep, and its walk version must renormalize together with the
+    pointer versions it will be compared against at drain time — handles
+    ride the renorm as extra non-seed run rows.  Under the eager engine
+    ``hr_count`` is always 0 and both extensions are inert.
+    """
     from kafkastreams_cep_tpu.ops import renorm as renorm_mod
     from kafkastreams_cep_tpu.ops import slab as slab_mod
 
-    run_off = jnp.where(state.alive, state.event_off, -1)
+    HB = state.hr_stage.shape[-1]
+    R = state.alive.shape[-1]
+    pending = (
+        jnp.arange(HB, dtype=jnp.int32)[None, :]
+        < state.hr_count[:, None]
+    )
+    run_off = jnp.concatenate(
+        [
+            jnp.where(state.alive, state.event_off, -1),
+            jnp.where(pending, state.hr_off, -1),
+        ],
+        axis=-1,
+    )
     slab = jax.vmap(
         lambda s, ro: slab_mod.mark_sweep(s, None, ro, depth)
     )(state.slab, run_off)
     state = state._replace(slab=slab)
     if do_renorm:
-        ver, vlen, slab, _ = jax.vmap(renorm_mod.renorm_lane)(
-            state.ver, state.vlen, state.alive, state.id_pos, state.slab
+        ver_all = jnp.concatenate([state.ver, state.hr_ver], axis=-2)
+        vlen_all = jnp.concatenate([state.vlen, state.hr_vlen], axis=-1)
+        alive_all = jnp.concatenate([state.alive, pending], axis=-1)
+        # Handles are never seed runs (a match consumed events): id 0.
+        id_all = jnp.concatenate(
+            [state.id_pos, jnp.zeros_like(state.hr_vlen)], axis=-1
         )
-        state = state._replace(ver=ver, vlen=vlen, slab=slab)
+        ver2, vlen2, slab, _ = jax.vmap(renorm_mod.renorm_lane)(
+            ver_all, vlen_all, alive_all, id_all, state.slab
+        )
+        state = state._replace(
+            ver=ver2[..., :R, :],
+            vlen=vlen2[..., :R],
+            hr_ver=ver2[..., R:, :],
+            hr_vlen=vlen2[..., R:],
+            slab=slab,
+        )
     return state
 
 
@@ -241,6 +277,7 @@ class BatchMatcher:
             self.matcher.config, self.num_lanes
         )
         self.uses_walk_kernel = use_kernel
+        self._kernel_interpret = interpret
         if use_kernel:
             logger.info(
                 "batch matcher: fused walk kernel enabled (%d lanes%s)",
@@ -336,6 +373,73 @@ class BatchMatcher:
         do_renorm = self.matcher.config.renorm_versions
         return jax.jit(lambda state: sweep_lanes(state, depth, do_renorm))
 
+    def drain(self, state: EngineState):
+        """Materialize every pending lazy-extraction handle in one batched
+        pass (``engine/matcher.py: build_drain``) — the deferred analog of
+        the eager in-step extraction walks, off the per-step critical
+        path.  Returns ``(state, DrainOutput)`` with ``[K]``-leading
+        outputs; a no-op on eager or already-drained state."""
+        return self._drain_jit(state)
+
+    @functools.cached_property
+    def _drain_jit(self):
+        cfg = self.matcher.config
+        if not self.uses_walk_kernel:
+            return jax.jit(jax.vmap(self.matcher._drain_fn))
+        from kafkastreams_cep_tpu.ops.walk_kernel import walk_pass_kernel
+
+        HB, W, EH, D = (
+            cfg.handle_ring, cfg.max_walk, cfg.slab_hot_entries,
+            cfg.dewey_depth,
+        )
+        interpret = self._kernel_interpret
+
+        def drain(state: EngineState):
+            i32 = jnp.int32
+            pending = (
+                jnp.arange(HB, dtype=i32)[None, :]
+                < state.hr_count[:, None]
+            )  # [K, HB]
+            slab = state.slab
+            unpin = jnp.sum(
+                (
+                    (slab.stage[:, None, :] == state.hr_stage[:, :, None])
+                    & (slab.off[:, None, :] == state.hr_off[:, :, None])
+                    & pending[:, :, None]
+                ).astype(i32),
+                axis=1,
+            )  # [K, E]
+            slab = slab._replace(refs=jnp.maximum(slab.refs - unpin, 0))
+            ones = jnp.ones_like(pending)
+            slab, out_stage, out_off, count = walk_pass_kernel(
+                slab, pending, state.hr_stage, state.hr_off,
+                state.hr_ver, state.hr_vlen, ones, ones,
+                max_walk=W, out_base=0, out_rows=HB,
+                interpret=interpret, hot_entries=EH, drain=True,
+            )
+            out = DrainOutput(
+                stage=out_stage,
+                off=out_off,
+                count=jnp.where(pending, count, 0),
+                seq=jnp.where(pending, state.hr_seq, -1),
+                row=jnp.where(pending, state.hr_row, -1),
+                ts=jnp.where(pending, state.hr_ts, -1),
+            )
+            state = state._replace(
+                slab=slab,
+                hr_stage=jnp.full_like(state.hr_stage, -1),
+                hr_off=jnp.full_like(state.hr_off, -1),
+                hr_ver=jnp.zeros_like(state.hr_ver),
+                hr_vlen=jnp.zeros_like(state.hr_vlen),
+                hr_ts=jnp.zeros_like(state.hr_ts),
+                hr_seq=jnp.zeros_like(state.hr_seq),
+                hr_row=jnp.zeros_like(state.hr_row),
+                hr_count=jnp.zeros_like(state.hr_count),
+            )
+            return state, out
+
+        return jax.jit(drain)
+
     def counters(self, state: EngineState) -> Dict[str, int]:
         """Aggregate overflow/drop counters summed over all lanes."""
         return {
@@ -349,6 +453,14 @@ class BatchMatcher:
         return {
             n: int(jnp.sum(v))
             for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
+        }
+
+    def walk_counters(self, state: EngineState) -> Dict[str, int]:
+        """Walk-cost telemetry summed over all lanes (hop counts by
+        walker class; not loss indicators)."""
+        return {
+            n: int(jnp.sum(v))
+            for n, v in zip(WALK_COUNTER_NAMES, walk_counter_values(state))
         }
 
     def per_lane_counters(self, state: EngineState) -> Dict[str, list]:
@@ -367,5 +479,6 @@ class BatchMatcher:
         out: Dict[str, object] = {}
         out.update(self.counters(state))
         out.update(self.hot_counters(state))
+        out.update(self.walk_counters(state))
         out["per_lane"] = self.per_lane_counters(state)
         return out

@@ -22,12 +22,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kafkastreams_cep_tpu.engine.matcher import (
     COUNTER_NAMES,
     HOT_COUNTER_NAMES,
+    WALK_COUNTER_NAMES,
     EngineConfig,
     EngineState,
     EventBatch,
     TPUMatcher,
     counter_values,
     hot_counter_values,
+    walk_counter_values,
 )
 from kafkastreams_cep_tpu.parallel.batch import (
     _select_walk_kernel,
@@ -136,6 +138,7 @@ class ShardedMatcher:
                 [jnp.sum(v) for v in counter_values(state)]
                 + [jnp.sum(state.alive)]
                 + [jnp.sum(v) for v in hot_counter_values(state)]
+                + [jnp.sum(v) for v in walk_counter_values(state)]
             )
             return jax.lax.psum(local, self.axis)
 
@@ -196,7 +199,10 @@ class ShardedMatcher:
     def stats(self, state: EngineState) -> Dict[str, int]:
         """Mesh-global counter totals (one ``psum`` across all shards)."""
         vals = jax.device_get(self._stats(state))
-        keys = COUNTER_NAMES + ("alive_runs",) + HOT_COUNTER_NAMES
+        keys = (
+            COUNTER_NAMES + ("alive_runs",) + HOT_COUNTER_NAMES
+            + WALK_COUNTER_NAMES
+        )
         return {k: int(v) for k, v in zip(keys, vals)}
 
     def counters(self, state: EngineState) -> Dict[str, int]:
@@ -210,6 +216,28 @@ class ShardedMatcher:
         """Two-tier residency telemetry totals (BatchMatcher interface)."""
         stats = self.stats(state)
         return {k: stats[k] for k in HOT_COUNTER_NAMES}
+
+    def walk_counters(self, state: EngineState) -> Dict[str, int]:
+        """Walk-cost telemetry totals (BatchMatcher interface)."""
+        stats = self.stats(state)
+        return {k: stats[k] for k in WALK_COUNTER_NAMES}
+
+    def drain(self, state: EngineState):
+        """Materialize pending lazy-extraction handles on every shard
+        (lane-elementwise, collective-free — the BatchMatcher interface;
+        see ``engine/matcher.py: build_drain``)."""
+        return self._drain_jit(state)
+
+    @functools.cached_property
+    def _drain_jit(self):
+        local = jax.vmap(self.matcher._drain_fn)
+        spec = P(self.axis)
+        return jax.jit(
+            _shard_map(
+                local, mesh=self.mesh, in_specs=spec,
+                out_specs=(spec, spec), check_vma=False,
+            )
+        )
 
     def per_lane_counters(self, state: EngineState) -> Dict[str, list]:
         """Per-lane drop + hot counters gathered from every shard:

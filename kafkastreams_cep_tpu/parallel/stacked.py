@@ -113,6 +113,7 @@ class StackedBankMatcher:
 
         self._scan_fn = scan
         self.scan_flat = jax.jit(scan_rep)
+        self._drain_jit = None  # built on first drain() (lazy configs)
 
     def names_of(self, q: int) -> List[str]:
         return self.tables_list[q].names
@@ -154,6 +155,28 @@ class StackedBankMatcher:
             for n, v in zip(HOT_COUNTER_NAMES, hot_counter_values(state))
         }
 
+    def walk_counters(self, state: EngineState) -> Dict[str, int]:
+        """Walk-cost telemetry summed over all lanes."""
+        from kafkastreams_cep_tpu.engine.matcher import (
+            WALK_COUNTER_NAMES,
+            walk_counter_values,
+        )
+
+        return {
+            n: int(jnp.sum(v))
+            for n, v in zip(WALK_COUNTER_NAMES, walk_counter_values(state))
+        }
+
+    def drain(self, state: EngineState):
+        """Materialize pending lazy-extraction handles for every lane of
+        the stacked ``[Q*K]`` axis in one pass (the drain is table-free,
+        so one pass serves all bank members)."""
+        if self._drain_jit is None:
+            from kafkastreams_cep_tpu.engine.matcher import build_drain
+
+            self._drain_jit = jax.jit(jax.vmap(build_drain(self.config)))
+        return self._drain_jit(state)
+
     def per_query_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
         """Per-pattern attribution: drop + hot counters summed over each
         query's ``K``-lane block of the ``[Q*K]`` lane axis (lane layout is
@@ -177,6 +200,7 @@ class StackedBankMatcher:
         out: Dict[str, object] = {}
         out.update(self.counters(state))
         out.update(self.hot_counters(state))
+        out.update(self.walk_counters(state))
         out["per_pattern"] = self.per_query_counters(state)
         return out
 

@@ -82,13 +82,17 @@ class CEPBank:
         for p in procs[1:]:
             reg = reg.merge(p.metrics.registry)
         engine = merge_counter_dicts(
-            [{**p.counters(), **p.hot_counters()} for p in procs]
+            [
+                {**p.counters(), **p.hot_counters(), **p.walk_counters()}
+                for p in procs
+            ]
         )
         snap = Metrics(registry=reg).snapshot(engine)
         snap["per_pattern"] = {
             name: {
                 **p.counters(),
                 **p.hot_counters(),
+                **p.walk_counters(),
                 "records_in": p.metrics.records_in,
                 "matches_out": p.metrics.matches_out,
             }

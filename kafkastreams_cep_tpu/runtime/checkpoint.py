@@ -129,6 +129,7 @@ def save_checkpoint(
         "gc_events_interval": processor.gc_events_interval,
         "decode_budget": processor.decode_budget,
         "pipeline": processor.pipeline,
+        "drain_interval": processor.drain_interval,
         "lane_of": dict(processor._lane_of),
         "next_offset": processor._next_offset.copy(),
         "off_base": processor._off_base.copy(),
@@ -192,6 +193,7 @@ def restore_processor(
         gc_events_interval=header.get("gc_events_interval", 8),
         decode_budget=header.get("decode_budget", 131072),
         pipeline=header.get("pipeline", False),
+        drain_interval=header.get("drain_interval", 1),
         mesh=mesh,
     )
     if list(proc.batch.names) != list(header["stage_names"]):
@@ -209,6 +211,10 @@ def restore_processor(
             "(typed agg bit patterns are not translatable across dtypes)"
         )
     proc.state = proc.place(_unflatten_state(proc.state, ckpt["arrays"]))
+    # The drained-handle ordering base is derivable from device state:
+    # step_seq is the per-lane step counter (identical across lanes — all
+    # lanes step together), and a restore resumes exactly at it.
+    proc._step_base = int(np.max(np.asarray(ckpt["arrays"]["step_seq"])))
     proc._lane_of = dict(header["lane_of"])
     proc._key_of = {v: k for k, v in proc._lane_of.items()}
     proc._next_offset = np.asarray(header["next_offset"]).copy()

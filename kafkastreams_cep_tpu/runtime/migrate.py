@@ -82,9 +82,11 @@ logger = get_logger("runtime.migrate")
 # stream, not capacity).
 _SHAPE_DIMS = (
     "max_runs", "slab_entries", "slab_preds", "dewey_depth", "max_walk",
+    "handle_ring",
 )
 _SEMANTIC_FLAGS = (
     "renorm_versions", "enforce_windows", "sequential_slab", "walker_budget",
+    "lazy_extraction",
 )
 
 
@@ -157,7 +159,17 @@ def widen_state(
         hot_misses=g(slab.hot_misses),
         overflow_walks=g(slab.overflow_walks),
         demotions=g(slab.demotions),
+        walk_hops=g(slab.walk_hops),
+        extract_hops=g(slab.extract_hops),
+        drain_hops=g(slab.drain_hops),
     )
+    # Handle-ring axis (HB -> HB'): pending handles occupy a contiguous
+    # prefix in completion order (appends at hr_count, drain clears to 0),
+    # so appending empty slots — the same fill values init_state/drain use
+    # — is exactly the state a wide ring would hold; a ring slot past
+    # hr_count is never read.  The widened ring only retains what the
+    # narrow ring would have counted in handle_overflows.
+    HB2 = new.handle_ring
     return EngineState(
         alive=_pad(g(state.alive), -1, R2, False),
         id_pos=_pad(g(state.id_pos), -1, R2, -1),
@@ -171,6 +183,16 @@ def widen_state(
         slab=new_slab,
         run_drops=g(state.run_drops),
         ver_overflows=g(state.ver_overflows),
+        hr_stage=_pad(g(state.hr_stage), -1, HB2, -1),
+        hr_off=_pad(g(state.hr_off), -1, HB2, -1),
+        hr_ver=_pad(_pad(g(state.hr_ver), -1, D2, 0), -2, HB2, 0),
+        hr_vlen=_pad(g(state.hr_vlen), -1, HB2, 0),
+        hr_ts=_pad(g(state.hr_ts), -1, HB2, 0),
+        hr_seq=_pad(g(state.hr_seq), -1, HB2, 0),
+        hr_row=_pad(g(state.hr_row), -1, HB2, 0),
+        hr_count=g(state.hr_count),
+        step_seq=g(state.step_seq),
+        handle_overflows=g(state.handle_overflows),
     )
 
 
@@ -200,6 +222,11 @@ def canonical_state(state: EngineState) -> EngineState:
     )
     d = lambda m, arr, fill: np.where(m, g(arr), fill)
     dp = live_p[..., None]  # broadcast over the Dewey axis
+    # Ring slots past the pending prefix are never read (appends write at
+    # hr_count, drain reads [0, hr_count)); their residue differs between
+    # the drain implementations, so they canonicalize to the init fills.
+    hb = state.hr_stage.shape[-1]
+    pend = np.arange(hb, dtype=np.int32) < g(state.hr_count)[..., None]
     return EngineState(
         alive=alive,
         id_pos=d(alive, state.id_pos, -1),
@@ -222,6 +249,16 @@ def canonical_state(state: EngineState) -> EngineState:
         ),
         run_drops=g(state.run_drops),
         ver_overflows=g(state.ver_overflows),
+        hr_stage=d(pend, state.hr_stage, -1),
+        hr_off=d(pend, state.hr_off, -1),
+        hr_ver=d(pend[..., None], state.hr_ver, 0),
+        hr_vlen=d(pend, state.hr_vlen, 0),
+        hr_ts=d(pend, state.hr_ts, 0),
+        hr_seq=d(pend, state.hr_seq, 0),
+        hr_row=d(pend, state.hr_row, 0),
+        hr_count=g(state.hr_count),
+        step_seq=g(state.step_seq),
+        handle_overflows=g(state.handle_overflows),
     )
 
 
@@ -257,6 +294,7 @@ def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
         gc_events_interval=proc.gc_events_interval,
         decode_budget=proc.decode_budget,
         pipeline=proc.pipeline,
+        drain_interval=proc.drain_interval,
         mesh=mesh if mesh is not None else proc.mesh,
     )
     if list(new_proc.batch.names) != list(proc.batch.names):
@@ -274,6 +312,7 @@ def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
     new_proc._events = [dict(d) for d in proc._events]
     new_proc._col_batches = list(proc._col_batches)
     new_proc._value_proto = proc._value_proto
+    new_proc._step_base = proc._step_base  # pending-handle ordering base
     new_proc.metrics = proc.metrics  # continuity: one stream, one meter
     logger.info(
         "migrated processor %s -> %s",

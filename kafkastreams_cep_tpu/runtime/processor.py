@@ -135,6 +135,7 @@ class CEPProcessor:
         mesh=None,
         trace_sink: Optional[TraceSink] = None,
         name: Optional[str] = None,
+        drain_interval: int = 1,
     ):
         # ``mesh``: a ``jax.sharding.Mesh`` shards the lane axis over the
         # devices (state-follows-partition, ``CEPProcessor.java:117-134`` —
@@ -180,6 +181,19 @@ class CEPProcessor:
         self.pipeline = bool(pipeline)
         self._pending: Optional[tuple] = None
         self.state = self.batch.init_state()
+        # Lazy extraction (EngineConfig.lazy_extraction): completed matches
+        # are compact device handles until the batched drain pass
+        # materializes them.  ``drain_interval`` sets the drain cadence in
+        # batches (1 = every batch, the default — matches the eager
+        # engine's emission latency exactly; larger values trade latency
+        # for fewer drain dispatches and need a handle ring sized for the
+        # longer interval).  ``flush()`` and checkpoints always drain.
+        self.lazy = bool(self.batch.matcher.config.lazy_extraction)
+        self.drain_interval = max(int(drain_interval), 1)
+        # step_seq value at the start of the current batch's scan — maps a
+        # drained handle's absolute completion step back to this batch's
+        # t-axis (arrival ordering); restored from device state on resume.
+        self._step_base = 0
         self.epoch = epoch  # None = rebase to the first record's timestamp
         self.gc_events = gc_events
         self.dedup = dedup
@@ -656,19 +670,35 @@ class CEPProcessor:
         if self.mesh is not None:
             events = self.batch.shard_events(events)
 
+        base = self._step_base
         with self._phase("dispatch"):
             # Enqueue only: the scan (and any due sweep) dispatch async;
             # the wait is attributed to the device phase below.
             self.state, out = self.batch.scan(self.state, events)
+            self._step_base += int(events.ts.shape[1])
             if self.gc_interval and (self.metrics.batches + 1) % self.gc_interval == 0:
+                # Pending lazy handles survive the sweep by construction:
+                # they are mark-sweep liveness roots and renorm rows
+                # (parallel/batch.py sweep_lanes).
                 self.state = self.batch.sweep(self.state)
+        drain_out = None
+        if self.lazy and (
+            (self.metrics.batches + 1) % self.drain_interval == 0
+        ):
+            with self._phase("drain"):
+                # One batched pass materializes every pending handle —
+                # the deferred analog of the eager in-step extraction
+                # walks, off the per-step critical path.
+                self.state, drain_out = self.batch.drain(self.state)
         with self._phase("device"):
             if not self.pipeline:
                 # Serial mode: wait here so device_seconds is the real
                 # device wall time.  Pipelined mode never blocks on the
                 # fresh dispatch — the wait lands in the next call's
                 # decode of THIS batch, overlapped with its device scan.
-                jax.block_until_ready(out.count)
+                jax.block_until_ready(
+                    out.count if drain_out is None else drain_out.count
+                )
         _failpoint("device.result")
         gc_due = self.gc_events and (
             (self.metrics.batches + 1) % self.gc_events_interval == 0
@@ -677,7 +707,9 @@ class CEPProcessor:
         self.metrics.batches += 1
         with self._phase("decode"):
             if self.pipeline:
-                prev, self._pending = self._pending, (out, rank_of)
+                prev, self._pending = (
+                    self._pending, (out, rank_of, drain_out, base),
+                )
                 matches = self._decode(*prev) if prev is not None else []
                 if gc_due:
                     # The GC liveness pull must not prune events the
@@ -685,7 +717,7 @@ class CEPProcessor:
                     pend, self._pending = self._pending, None
                     matches += self._decode(*pend)
             else:
-                matches = self._decode(out, rank_of)
+                matches = self._decode(out, rank_of, drain_out, base)
         if gc_due:
             with self._phase("gc"):
                 self._gc_events()
@@ -694,18 +726,107 @@ class CEPProcessor:
 
     def flush(self) -> List[Tuple[Hashable, Sequence]]:
         """Drain the pipelined in-flight batch (no-op in serial mode or
-        when nothing is pending).  Call before checkpointing a pipelined
-        processor — a snapshot cannot carry undecoded device outputs."""
-        if self._pending is None:
-            return []
-        out, rank_of = self._pending
-        self._pending = None
-        with self._phase("decode"):
-            matches = self._decode(out, rank_of)
+        when nothing is pending), and — under lazy extraction — also
+        drain any handles still pending on device (a ``drain_interval``
+        > 1 leaves up to interval-1 batches' matches undrained).  Call
+        before checkpointing a pipelined processor — a snapshot cannot
+        carry undecoded device outputs."""
+        matches: List[Tuple[Hashable, Sequence]] = []
+        if self._pending is not None:
+            pend, self._pending = self._pending, None
+            with self._phase("decode"):
+                matches = self._decode(*pend)
+        if self.lazy:
+            with self._phase("drain"):
+                self.state, dout = self.batch.drain(self.state)
+            with self._phase("decode"):
+                # No rank_of: everything pending predates "now", so the
+                # order key degrades to (completion step, lane, run row).
+                matches += self._decode_drained(dout, None, self._step_base)
         self.metrics.matches_out += len(matches)
         return matches
 
-    def _decode(self, out, rank_of) -> List[Tuple[Hashable, Sequence]]:
+    def _decode(
+        self, out, rank_of, drain_out=None, base=0
+    ) -> List[Tuple[Hashable, Sequence]]:
+        """One batch's matches: the eager ``StepOutput`` grid (empty under
+        lazy extraction) plus, when a drain ran, the drained handles."""
+        matches = [] if self.lazy else self._decode_eager(out, rank_of)
+        if drain_out is not None:
+            matches = matches + self._decode_drained(
+                drain_out, rank_of, base
+            )
+        return matches
+
+    def _decode_drained(
+        self, dout, rank_of, base
+    ) -> List[Tuple[Hashable, Sequence]]:
+        """Drained handles -> (key, Sequence) in the eager emission order.
+
+        Handles completed in THIS batch (``seq >= base``) order exactly
+        like the eager path — by arrival rank of the completing record,
+        then run-queue row; handles deferred from earlier batches (only
+        with ``drain_interval > 1`` or after a restore) emit first, by
+        (completion step, lane, run row).
+
+        Fast path mirrors the eager decode: the hit rows compact
+        on-device (``ops/decode.py: compact_drained``) so the host pulls
+        rows proportional to the match count, not ``lanes x ring``.
+        """
+        if self.decode_budget:
+            from kafkastreams_cep_tpu.ops.decode import compact_drained
+
+            K, HB = dout.count.shape
+            c_stage, c_off, c_count, c_seq, c_row, c_k, c_n, _ovf = (
+                compact_drained(dout, self.decode_budget)
+            )
+            n = int(c_n)
+            if n <= min(self.decode_budget, K * HB):
+                if n == 0:
+                    return []
+                m = 1
+                while m < n:
+                    m *= 2
+                m = min(m, int(c_count.shape[0]))
+                cnts, stages, offs, seqs, rows, ks = jax.device_get(
+                    (c_count[:m], c_stage[:m], c_off[:m], c_seq[:m],
+                     c_row[:m], c_k[:m])
+                )
+                return self._emit_drained(
+                    ks[:n], cnts[:n], stages[:n], offs[:n], seqs[:n],
+                    rows[:n], rank_of, base,
+                )
+            self.metrics.decode_fallbacks += 1
+        count = np.asarray(jax.device_get(dout.count))  # [K, HB]
+        ks, hs = np.nonzero(count)
+        if ks.size == 0:
+            return []
+        stage, off, seqa, rowa = (
+            np.asarray(jax.device_get(x))
+            for x in (dout.stage, dout.off, dout.seq, dout.row)
+        )
+        return self._emit_drained(
+            ks, count[ks, hs], stage[ks, hs], off[ks, hs], seqa[ks, hs],
+            rowa[ks, hs], rank_of, base,
+        )
+
+    def _emit_drained(self, ks, cnts, stages, offs, seqs, rows, rank_of,
+                      base):
+        if rank_of is not None:
+            cur = seqs >= base
+            t_idx = np.clip(seqs - base, 0, rank_of.shape[1] - 1)
+            key2 = np.where(cur, rank_of[ks, t_idx], seqs)
+        else:
+            cur = np.zeros(ks.shape, bool)
+            key2 = seqs
+        order = np.lexsort(
+            (rows, np.where(cur, 0, ks), key2, cur.astype(np.int8))
+        )
+        return self._build_matches(
+            ks[order], cnts[order], stages[order], offs[order]
+        )
+
+    def _decode_eager(self, out, rank_of) -> List[Tuple[Hashable, Sequence]]:
         """Device walk outputs -> (key, Sequence), in arrival order.
 
         Fast path: the batch's match rows compact on-device into a GLOBAL
@@ -763,8 +884,12 @@ class CEPProcessor:
         """Hit rows -> (key, Sequence) in arrival order (rank of the
         completing record), then run-queue order."""
         order = np.lexsort((rs, rank_of[ks, ts]))
-        ks, cnts = ks[order], cnts[order]
-        stages, offs = stages[order], offs[order]
+        return self._build_matches(
+            ks[order], cnts[order], stages[order], offs[order]
+        )
+
+    def _build_matches(self, ks, cnts, stages, offs):
+        """Already-ordered hit rows -> (key, Sequence) objects."""
         names = self.batch.names
         matches: List[Tuple[Hashable, Sequence]] = []
         for i in range(ks.size):
@@ -860,6 +985,11 @@ class CEPProcessor:
         all zero when ``slab_hot_entries == 0``)."""
         return self.batch.hot_counters(self.state)
 
+    def walk_counters(self) -> Dict[str, int]:
+        """Walk-cost telemetry of the live state (lane-summed hop counts
+        by walker class — the reduce-width perf model's observables)."""
+        return self.batch.walk_counters(self.state)
+
     def metrics_snapshot(self, per_lane: bool = True) -> Dict[str, Any]:
         """Runtime metrics + engine counters + attribution in one dict.
 
@@ -875,6 +1005,7 @@ class CEPProcessor:
         snap: Dict[str, Any] = self.metrics.snapshot(self.counters())
         hot = self.hot_counters()
         snap.update(hot)
+        snap.update(self.walk_counters())
         snap["watermark"] = self._watermark
         snap["event_time_lag_ms"] = (
             int(time.time() * 1000) - self._watermark

@@ -41,12 +41,14 @@ SECONDS_ATTRS = (
     "decode_seconds",
     "pack_seconds",
     "dispatch_seconds",
+    "drain_seconds",
     "gc_seconds",
 )
 
 #: The batch phases every processor pre-registers, so snapshots of runs
-#: that never hit a phase (e.g. gc off) still carry identical key sets.
-PHASE_NAMES = ("pack", "dispatch", "device", "decode", "gc")
+#: that never hit a phase (e.g. gc off, eager extraction) still carry
+#: identical key sets.
+PHASE_NAMES = ("pack", "dispatch", "drain", "device", "decode", "gc")
 
 
 def _counter_property(name: str) -> property:

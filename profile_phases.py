@@ -63,6 +63,9 @@ def mk_slab():
         hot_misses=jnp.zeros((K,), i32),
         overflow_walks=jnp.zeros((K,), i32),
         demotions=jnp.zeros((K,), i32),
+        walk_hops=jnp.zeros((K,), i32),
+        extract_hops=jnp.zeros((K,), i32),
+        drain_hops=jnp.zeros((K,), i32),
     )
 
 

@@ -25,6 +25,7 @@ acceptance criterion asks for is ``-m slow`` (same harness, more seeds).
 """
 
 import collections
+import dataclasses
 import os
 
 import numpy as np
@@ -41,6 +42,10 @@ from kafkastreams_cep_tpu.utils import failpoints as fp
 CFG = EngineConfig(
     max_runs=16, slab_entries=48, slab_preds=8, dewey_depth=16, max_walk=12
 )
+# Lazy extraction under chaos: a crash can land between match completion
+# (handles pinned in the ring) and the drain — the recovery must replay to
+# exactly-once emission through the deferred path too.
+LAZY_CFG = dataclasses.replace(CFG, lazy_extraction=True, handle_ring=16)
 KEYS = ("k0", "k1")
 N_BATCHES = 6
 BATCH_SIZE = 4
@@ -84,18 +89,20 @@ def canon_match(key, seq):
     )))
 
 
-def oracle_run(batches):
+def oracle_run(batches, cfg=CFG):
     """Clean same-batching run: final state + emitted match multiset."""
-    proc = CEPProcessor(sc.skip_till_any(), len(KEYS), CFG, gc_interval=0)
+    proc = CEPProcessor(sc.skip_till_any(), len(KEYS), cfg, gc_interval=0)
     emitted = collections.Counter()
     for b in batches:
         for k, seq in proc.process(b):
             emitted[canon_match(k, seq)] += 1
+    for k, seq in proc.flush():
+        emitted[canon_match(k, seq)] += 1
     return proc.state, emitted
 
 
-def make_supervisor(ck, jr, resume=False):
-    args = (sc.skip_till_any(), len(KEYS), CFG)
+def make_supervisor(ck, jr, resume=False, cfg=CFG):
+    args = (sc.skip_till_any(), len(KEYS), cfg)
     kw = dict(
         checkpoint_path=ck, journal_path=jr, checkpoint_every=2,
         gc_interval=0,
@@ -105,12 +112,12 @@ def make_supervisor(ck, jr, resume=False):
     return Supervisor(*args, **kw)
 
 
-def run_chaos(seed, tmp_path):
+def run_chaos(seed, tmp_path, cfg=CFG):
     batches = gen_batches(seed)
     rng = np.random.default_rng(seed + 10_000)
     ck = str(tmp_path / f"chaos{seed}.ckpt")
     jr = str(tmp_path / f"chaos{seed}.jrnl")
-    sup = make_supervisor(ck, jr)
+    sup = make_supervisor(ck, jr, cfg=cfg)
     emitted = collections.Counter()
     dups_allowed = False
     faults_fired = 0
@@ -151,15 +158,17 @@ def run_chaos(seed, tmp_path):
             elif rng.random() < 0.2:
                 fp.corrupt_journal_tail(jr, seed=seed)
             del sup
-            sup = make_supervisor(ck, jr, resume=True)
+            sup = make_supervisor(ck, jr, resume=True, cfg=cfg)
             i = 0  # at-least-once source: re-submit all; dedup absorbs
     return sup, emitted, dups_allowed, faults_fired, crashes
 
 
-def assert_chaos_invariants(seed, tmp_path):
+def assert_chaos_invariants(seed, tmp_path, cfg=CFG):
     batches = gen_batches(seed)
-    want_state, want_matches = oracle_run(batches)
-    sup, emitted, dups_allowed, faults, crashes = run_chaos(seed, tmp_path)
+    want_state, want_matches = oracle_run(batches, cfg)
+    sup, emitted, dups_allowed, faults, crashes = run_chaos(
+        seed, tmp_path, cfg
+    )
     import jax
 
     ca = canonical_state(sup.processor.state)
@@ -192,7 +201,21 @@ def test_chaos_schedule_fast(seed, tmp_path):
     assert_chaos_invariants(seed, tmp_path)
 
 
+@pytest.mark.parametrize("seed", [4])
+def test_chaos_schedule_lazy(seed, tmp_path):
+    """The same schedules through the lazy-extraction engine: crashes
+    between match completion (pinned handles) and drain must still
+    converge to the oracle's state and exactly-once emission."""
+    assert_chaos_invariants(seed, tmp_path, cfg=LAZY_CFG)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", range(100, 300))  # 200 schedules
 def test_chaos_schedule_sweep(seed, tmp_path):
     assert_chaos_invariants(seed, tmp_path)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 6] + list(range(300, 320)))
+def test_chaos_schedule_lazy_sweep(seed, tmp_path):
+    assert_chaos_invariants(seed, tmp_path, cfg=LAZY_CFG)

@@ -189,6 +189,51 @@ def test_two_tier_slab_widens_with_hot_window_intact():
     )
 
 
+def test_handle_ring_widens_with_pending_handles():
+    """Lazy extraction: widening (handle_ring alone, and combined with
+    every other dim) with a NON-EMPTY handle ring embeds the pending
+    handles — the wide engine drains them to bit-identical matches and
+    keeps matching identically afterwards."""
+    lazy_narrow = dataclasses.replace(
+        NARROW, lazy_extraction=True, handle_ring=64
+    )
+    widenings = dict(
+        ring=dict(handle_ring=96),
+        combined=dict(handle_ring=96, **WIDENINGS["combined"]),
+    )
+    K, T = 8, 12
+    prefix = stock_events(K, T, 23)
+    suffix = stock_events(K, T, 123, t0=T)
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    narrow = BatchMatcher(stock_demo.stock_pattern(), K, lazy_narrow)
+    mid, _ = narrow.scan(narrow.init_state(), prefix)  # NOT drained
+    assert int(jnp.sum(mid.hr_count)) > 0
+    st_n, _ = narrow.scan(mid, suffix)
+    st_n, d_n = narrow.drain(st_n)
+    assert not any(capacity_counters(narrow.counters(st_n)).values())
+    for name, w in widenings.items():
+        wide_cfg = dataclasses.replace(lazy_narrow, **w)
+        wide = BatchMatcher(stock_demo.stock_pattern(), K, wide_cfg)
+        mid_w = jax.device_put(widen_state(mid, lazy_narrow, wide_cfg))
+        st_w, _ = wide.scan(mid_w, suffix)
+        st_w, d_w = wide.drain(st_w)
+        HB, W0 = lazy_narrow.handle_ring, lazy_narrow.max_walk
+        for f in d_n._fields:
+            a = np.asarray(getattr(d_n, f))
+            b = np.asarray(getattr(d_w, f))
+            if b.ndim == 3:  # [K, HB', W'] hop rows
+                assert (b[:, :HB, W0:] == -1).all(), f"{name}: drain.{f}"
+                b = b[:, :HB, :W0]
+            else:
+                b = b[:, :HB]
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"{name}: drain.{f}"
+            )
+            assert not (np.asarray(getattr(d_w, f))[:, HB:] > 0).any() \
+                if f == "count" else True
+        assert narrow.counters(st_n) == wide.counters(st_w), name
+
+
 def test_check_widens_refusals():
     with pytest.raises(ValueError, match="shrink"):
         check_widens(NARROW, dataclasses.replace(NARROW, max_runs=8))

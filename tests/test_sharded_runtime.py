@@ -241,4 +241,5 @@ def test_sharded_scan_exact_stats_and_outputs(mesh8):
     expect = dict(ref_counters)
     expect["alive_runs"] = int(jnp.sum(bstate.alive))
     expect.update(batch.hot_counters(bstate))
+    expect.update(batch.walk_counters(bstate))
     assert sharded.stats(sstate) == expect
