@@ -29,7 +29,10 @@ default 16, 0 skips), ``CEP_BENCH_LAZY`` (lazy-extraction A/B on the
 headline trace, default 1; ``CEP_BENCH_LAZY_{CHUNK,RING,E}`` set the
 drain cadence, handle-ring size, and slab headroom),
 ``CEP_BENCH_FRONTIER`` ("E:EH,E:EH,…" — the (E, E_hot) frontier sweep,
-off by default), ``CEP_BENCH_METRICS=1`` (run the headline config
+off by default), ``CEP_BENCH_OOO`` (graceful-ingestion A/B: in-order vs
+bounded-skew shuffled arrival through the watermark reorder buffer,
+default 1; ``CEP_BENCH_OOO_{K,B,BATCHES,GRACE}`` size it),
+``CEP_BENCH_METRICS=1`` (run the headline config
 under the telemetry Reporter and print the per-phase p50/p99 block;
 ``CEP_BENCH_METRICS_{K,T,BATCHES}`` size it), ``CEP_PLATFORM`` (force a
 JAX platform, e.g. ``cpu``).
@@ -1172,6 +1175,107 @@ def bench_resilience():
     return out
 
 
+def bench_ooo():
+    """``CEP_BENCH_OOO``: graceful-ingestion A/B (ISSUE 5).
+
+    The same record stream three ways through the per-record processor
+    path: (a) no guard, in-order — the historical front door; (b) the
+    watermark reorder buffer, in-order — the guard's bookkeeping
+    overhead; (c) the guard with a bounded-skew (<= grace) shuffled
+    arrival — the production case the buffer exists for.  Reports ev/s
+    for each, the reorder overhead, match-count parity (all three must
+    agree: the release stream is the sorted stream), and the loss
+    counters (all-zero ⇒ the shuffle was fully absorbed).
+
+    ``CEP_BENCH_OOO_{K,B,BATCHES,GRACE}`` size it.  Record-path rates are
+    host-bound (µs/record Python), so this measures the guard's relative
+    cost, not engine throughput — the columnar numbers stay the
+    throughput story.
+    """
+    from kafkastreams_cep_tpu.runtime import CEPProcessor, IngestPolicy, Record
+
+    K = int(os.environ.get("CEP_BENCH_OOO_K", "64"))
+    n_batches = int(os.environ.get("CEP_BENCH_OOO_BATCHES", "8"))
+    batch_records = int(os.environ.get("CEP_BENCH_OOO_B", "2048"))
+    grace = int(os.environ.get("CEP_BENCH_OOO_GRACE", "64"))
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    rng = np.random.default_rng(17)
+    N = n_batches * batch_records
+    keys = rng.integers(0, K, size=N)
+    prices = rng.integers(90, 131, size=N)
+    vols = np.where(
+        rng.random(N) < 0.005, 1100, rng.integers(700, 1000, size=N)
+    )
+    ts = np.arange(N, dtype=np.int64) * 2  # distinct event times
+    recs = [
+        Record(
+            int(keys[i]),
+            {"price": int(prices[i]), "volume": int(vols[i])},
+            int(ts[i]),
+        )
+        for i in range(N)
+    ]
+    skew_key = ts + rng.uniform(0, grace, size=N)
+    shuffled = [recs[i] for i in np.argsort(skew_key, kind="stable")]
+
+    def run(records, policy):
+        proc = CEPProcessor(
+            stock_demo.stock_pattern(), K, cfg, epoch=0, ingest=policy,
+        )
+        # Two warmup batches: the guard's watermark hold shifts released
+        # batch sizes onto different T buckets than the raw path, and the
+        # resulting recompiles belong to warmup, not the timed window.
+        warm = min(2, n_batches - 1)
+        n_matches = 0
+        for b in range(warm):
+            n_matches += len(
+                proc.process(
+                    records[b * batch_records:(b + 1) * batch_records]
+                )
+            )
+        t0 = time.perf_counter()  # host-timed (record path is host-bound)
+        for b in range(warm, n_batches):
+            n_matches += len(
+                proc.process(
+                    records[b * batch_records:(b + 1) * batch_records]
+                )
+            )
+        n_matches += len(proc.drain_ingest())
+        n_matches += len(proc.flush())
+        dt = time.perf_counter() - t0
+        return proc, (n_batches - warm) * batch_records / dt, n_matches
+
+    _, base_evps, base_m = run(recs, None)
+    _, in_evps, in_m = run(recs, IngestPolicy(grace_ms=grace))
+    p_sh, sh_evps, sh_m = run(shuffled, IngestPolicy(grace_ms=grace))
+    loss = p_sh._guard.loss_counters()
+    out = {
+        "grace_ms": grace,
+        "records": N,
+        "evps_no_guard": round(base_evps, 1),
+        "evps_guard_inorder": round(in_evps, 1),
+        "evps_guard_shuffled": round(sh_evps, 1),
+        "reorder_overhead_pct": round(100 * (1 - in_evps / base_evps), 1),
+        "shuffled_overhead_pct": round(100 * (1 - sh_evps / base_evps), 1),
+        "matches": base_m,
+        "match_parity": bool(base_m == in_m == sh_m),
+        "loss_counters": loss,
+        "loss_free": not any(loss.values()),
+    }
+    log(
+        f"ooo ({N} records, {K} lanes, grace {grace} ms): no-guard "
+        f"{base_evps / 1e3:.0f}K ev/s, guard in-order {in_evps / 1e3:.0f}K "
+        f"ev/s ({out['reorder_overhead_pct']}% overhead), guard shuffled "
+        f"{sh_evps / 1e3:.0f}K ev/s ({out['shuffled_overhead_pct']}% "
+        f"overhead); match parity {out['match_parity']} "
+        f"({base_m}/{in_m}/{sh_m}), loss counters {loss}"
+    )
+    return out
+
+
 def bench_oracle(n_events):
     rng = np.random.default_rng(42)
     prices = rng.integers(90, 131, size=n_events)
@@ -1232,9 +1336,18 @@ def main():
     # device tunnel are slow and the headline JSON must always be printed.
     resilience = {}
     proc_phases = {}
+    ooo = {}
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
         budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "1200"))
         extras = [
+            (
+                "ooo",
+                lambda: ooo.update(
+                    bench_ooo()
+                    if os.environ.get("CEP_BENCH_OOO", "1") == "1"
+                    else {}
+                ),
+            ),
             (
                 "resilience",
                 lambda: resilience.update(bench_resilience()),
@@ -1365,6 +1478,11 @@ def main():
                 # extra's telemetry histograms (ISSUE 3) — tail behavior,
                 # not just throughput (None when extras are skipped).
                 "phase_latency": proc_phases or None,
+                # Graceful-ingestion A/B (ISSUE 5): in-order vs bounded-
+                # skew shuffled arrival through the watermark reorder
+                # buffer — reorder overhead, match parity, loss counters
+                # (None when extras are skipped or CEP_BENCH_OOO=0).
+                "ooo": ooo or None,
             }
         ),
         flush=True,

@@ -57,6 +57,21 @@ _COUNTER_KNOB = {
     "handle_overflows": "handle_ring",
 }
 
+# Ingestion-guard loss counters and the IngestPolicy knob each one grows
+# (runtime/ingest.py) — the host-side twin of _COUNTER_KNOB: a late drop
+# means the grace window under-covered the stream's skew, an eviction
+# means the reorder buffer was too shallow for the in-flight disorder.
+# ``quarantined`` is deliberately absent: a schema/lane defect is a data
+# defect, not a capacity defect — no knob makes a malformed record valid.
+_INGEST_COUNTER_KNOB = {
+    "late_dropped": "grace_ms",
+    "reorder_evictions": "reorder_depth",
+}
+
+# Additive growth floors for knobs whose current value may be 0 (a pure
+# multiplier would never move grace_ms off zero).
+_INGEST_KNOB_FLOOR = {"grace_ms": 1000, "reorder_depth": 64}
+
 
 class ProbeReport(NamedTuple):
     """What one instrumented sample run observed."""
@@ -219,6 +234,44 @@ def suggest_handle_ring(max_matches_chunk: int, margin: float = 1.5) -> int:
 def capacity_counters(counters: Dict[str, int]) -> Dict[str, int]:
     """The capacity-relevant subset of an engine counters dict."""
     return {k: counters[k] for k in _COUNTER_KNOB if k in counters}
+
+
+def ingest_capacity_counters(stats: Dict[str, int]) -> Dict[str, int]:
+    """The knob-growable subset of an ingestion-guard stats dict."""
+    return {k: stats[k] for k in _INGEST_COUNTER_KNOB if k in stats}
+
+
+def escalate_ingest(
+    policy,
+    tripped: Dict[str, int],
+    growth: float = 2.0,
+    max_policy=None,
+):
+    """The next wider :class:`~kafkastreams_cep_tpu.runtime.ingest.
+    IngestPolicy` for the loss counters in ``tripped`` (counter-name ->
+    positive-delta, names per ``_INGEST_COUNTER_KNOB``).
+
+    Unlike engine escalation this is *forward-only*: the supervisor does
+    not roll back and re-process (the dropped records are already in the
+    dead-letter queue, recoverable by the caller) — widening stops the
+    bleeding for the rest of the stream.  Returns None when nothing can
+    grow (at the ``max_policy`` ceiling, or no knob-mapped counter
+    tripped).
+    """
+    grown = {}
+    for counter, delta in tripped.items():
+        knob = _INGEST_COUNTER_KNOB.get(counter)
+        if knob is None or not delta:
+            continue
+        cur = getattr(policy, knob)
+        new = max(int(math.ceil(cur * growth)), cur + _INGEST_KNOB_FLOOR[knob])
+        if max_policy is not None:
+            new = min(new, getattr(max_policy, knob))
+        if new > cur:
+            grown[knob] = new
+    if not grown:
+        return None
+    return dataclasses.replace(policy, **grown)
 
 
 class EscalationPolicy(NamedTuple):

@@ -23,9 +23,15 @@ from kafkastreams_cep_tpu.runtime.processor import (
 )
 from kafkastreams_cep_tpu.runtime.bank import CEPBank
 from kafkastreams_cep_tpu.runtime.checkpoint import (
+    CheckpointCorrupt,
     restore_processor,
     save_checkpoint,
     load_checkpoint,
+)
+from kafkastreams_cep_tpu.runtime.ingest import (
+    DeadLetter,
+    IngestGuard,
+    IngestPolicy,
 )
 from kafkastreams_cep_tpu.runtime.migrate import (
     migrate_processor,
@@ -40,7 +46,11 @@ from kafkastreams_cep_tpu.runtime.supervisor import (
 __all__ = [
     "CEPBank",
     "CEPProcessor",
+    "CheckpointCorrupt",
+    "DeadLetter",
     "HealthReport",
+    "IngestGuard",
+    "IngestPolicy",
     "InputRejected",
     "Record",
     "Supervisor",

@@ -22,6 +22,7 @@ and fold functions are never written).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import io
 import pickle
 from typing import Any, Dict, Optional
@@ -48,6 +49,13 @@ logger = get_logger("runtime.checkpoint")
 # budget (runtime/processor.py) — no earlier released format carried a
 # per-lane meaning.
 FORMAT_VERSION = 3
+
+
+class CheckpointCorrupt(ValueError):
+    """The checkpoint file's payload does not match its recorded sha256
+    digest (bit rot, torn write, truncation).  The supervisor's resume
+    path falls back to the previous-good snapshot + journal-chain replay
+    instead of crashing (``runtime/supervisor.py``)."""
 
 
 def _flatten_state(state: EngineState) -> Dict[str, np.ndarray]:
@@ -135,9 +143,22 @@ def save_checkpoint(
         "off_base": processor._off_base.copy(),
         "events": [dict(d) for d in processor._events],
         "value_proto": processor._value_proto,
+        # Ingestion-guard state (runtime/ingest.py): records still held in
+        # the reorder buffer, watermark/frontier, dead letters, and loss
+        # counters — first-class durable state, restored verbatim so a
+        # resume releases exactly what the crashed process would have.
+        "ingest": (
+            processor._guard.to_state()
+            if processor._guard is not None
+            else None
+        ),
     }
     buf = io.BytesIO()
     np.savez(buf, **arrays)
+    # Payload integrity: a digest over the flattened state arrays, checked
+    # on load — a corrupt snapshot must fail loudly (and recoverably, via
+    # the supervisor's previous-good fallback), never restore flipped bits.
+    header["arrays_sha256"] = hashlib.sha256(buf.getvalue()).hexdigest()
     with open(path, "wb") as f:
         pickle.dump({"header": header, "arrays": buf.getvalue()}, f)
     logger.info(
@@ -147,16 +168,40 @@ def save_checkpoint(
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
-    """Read a checkpoint file into ``{header, arrays}``."""
-    with open(path, "rb") as f:
-        blob = pickle.load(f)
-    header = blob["header"]
+    """Read a checkpoint file into ``{header, arrays}``.
+
+    Raises :class:`CheckpointCorrupt` when the file cannot be parsed or
+    its array payload fails the header's sha256 digest."""
+    try:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        header = blob["header"]
+    except (OSError, FileNotFoundError):
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: {e})"
+        ) from e
     if header["format_version"] != FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {header['format_version']} unsupported"
         )
-    with np.load(io.BytesIO(blob["arrays"])) as z:
-        arrays = {k: z[k] for k in z.files}
+    want = header.get("arrays_sha256")
+    if want is not None:
+        got = hashlib.sha256(blob["arrays"]).hexdigest()
+        if got != want:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} failed integrity check: array payload "
+                f"sha256 {got} != header digest {want}"
+            )
+    try:
+        with np.load(io.BytesIO(blob["arrays"])) as z:
+            arrays = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} array payload is unreadable "
+            f"({type(e).__name__}: {e})"
+        ) from e
     return {"header": header, "arrays": arrays}
 
 
@@ -227,6 +272,10 @@ def restore_processor(
         proc._off_base = np.where(proc._next_offset > 0, 0, -1).astype(np.int64)
     proc._events = [dict(d) for d in header["events"]]
     proc._value_proto = header["value_proto"]
+    if header.get("ingest") is not None:
+        from kafkastreams_cep_tpu.runtime.ingest import IngestGuard
+
+        proc._guard = IngestGuard.from_state(header["ingest"])
     logger.info(
         "restored processor from %s: %d keys assigned, offsets %s",
         path, len(proc._lane_of), proc._next_offset.tolist(),

@@ -1,0 +1,324 @@
+"""Ingestion guard — watermark-driven out-of-order absorption + quarantine.
+
+The engine consumes records in arrival order and reproduces SASE+ run
+semantics over that order; real streams are out-of-order in *event time*
+and occasionally poisoned per record.  The reference absorbs both at the
+Kafka layer (partition logs are arrival-ordered; bad records are a serde
+concern); this module is the TPU runtime's front door analog:
+
+* **Reorder buffer.**  Admitted records are held in a bounded min-heap
+  keyed by event time and released only once the **watermark** — the max
+  event timestamp seen, minus ``grace_ms`` — passes them, in timestamp
+  order.  For any arrival shuffle whose timestamp inversions are bounded
+  by the grace (``|ts(y) - ts(x)| <= grace_ms`` whenever ``y`` arrives
+  before ``x`` with ``ts(y) > ts(x)``), the released stream is the
+  globally timestamp-sorted stream — identical to what the in-order
+  trace releases — so matches, emission order, and loss counters are
+  **bit-identical** to the in-order run (property-tested in
+  ``tests/test_ingest.py``).  Records with equal timestamps release in
+  arrival order.
+
+* **Quarantine / dead-letter.**  Per-record validation defects (schema,
+  lane overflow, timestamp range) and too-late events are diverted to a
+  capped dead-letter queue — record + typed reason + batch correlation
+  id — instead of rejecting the whole batch; the rest of the batch
+  proceeds.  ``on_bad_record="raise"`` preserves the strict batch-level
+  :class:`InputRejected` behavior.
+
+* **Loss counters.**  ``late_dropped`` (event time older than the
+  watermark at arrival), ``quarantined`` (validation defects), and
+  ``reorder_evictions`` (buffer-depth overflow force-released a record
+  before its watermark).  All three zero ⇒ the guard was loss-free and
+  the release stream is exactly the sorted admitted stream.
+
+The guard is first-class durable state: :func:`IngestGuard.to_state`
+round-trips through the checkpoint header (``runtime/checkpoint.py``),
+survives live migration (``runtime/migrate.py``), and replays
+deterministically from the supervisor journal — a crash with records
+held in the buffer recovers them from the snapshot + journal replay
+(chaos-tested with the ``ingest.admit`` / ``ingest.release`` failpoints).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.ingest")
+
+#: Typed dead-letter reasons (the quarantine policy table, README
+#: "Graceful ingestion").
+REASON_SCHEMA = "schema"
+REASON_LANE_OVERFLOW = "lane_overflow"
+REASON_TIME_RANGE = "time_range"
+REASON_LATE = "late"
+
+REASONS = (REASON_SCHEMA, REASON_LANE_OVERFLOW, REASON_TIME_RANGE, REASON_LATE)
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestPolicy:
+    """How the guard absorbs disorder and disposes of bad records.
+
+    ``grace_ms``       — watermark lag: a record is held until the max
+                         seen timestamp exceeds its own by this much
+                         (0 = release immediately; arrival order must
+                         then already be timestamp order).
+    ``reorder_depth``  — max records held across all lanes; overflow
+                         force-releases the earliest-timestamp record
+                         (counted in ``reorder_evictions`` — bounded
+                         memory, degraded ordering).
+    ``on_bad_record``  — ``"quarantine"`` (default): divert the record
+                         to the dead-letter queue and keep going;
+                         ``"raise"``: today's strict batch-level
+                         :class:`InputRejected`.
+    ``dead_letter_cap``— max retained dead letters; beyond it the oldest
+                         is dropped (counted, never silent).
+    """
+
+    grace_ms: int = 0
+    reorder_depth: int = 4096
+    on_bad_record: str = "quarantine"
+    dead_letter_cap: int = 1024
+
+    def __post_init__(self):
+        if self.on_bad_record not in ("quarantine", "raise"):
+            raise ValueError(
+                f"on_bad_record={self.on_bad_record!r}: expected "
+                "'quarantine' or 'raise'"
+            )
+        if self.grace_ms < 0 or self.reorder_depth < 1:
+            raise ValueError(
+                f"IngestPolicy needs grace_ms >= 0 and reorder_depth >= 1, "
+                f"got grace_ms={self.grace_ms} reorder_depth="
+                f"{self.reorder_depth}"
+            )
+
+
+class DeadLetter(NamedTuple):
+    """One quarantined record: what, why (typed), and which ingest batch."""
+
+    record: Any
+    reason: str
+    detail: str
+    corr: str
+
+
+class Defect(NamedTuple):
+    """A per-record validation verdict (``None`` = admissible).
+
+    ``silent=True`` marks drops that are policy, not loss (replay
+    duplicates) — they are counted by the caller, never dead-lettered.
+    """
+
+    reason: str
+    detail: str
+    silent: bool = False
+
+
+class IngestGuard:
+    """The reorder buffer + dead-letter queue of one processor.
+
+    Pure host state with no device or engine dependencies; the owning
+    :class:`CEPProcessor` drives validation (it owns the schema, lane
+    map, and epoch) and feeds admitted records through :meth:`push` /
+    :meth:`release`.
+    """
+
+    def __init__(self, policy: IngestPolicy):
+        self.policy = policy
+        # Min-heap of (timestamp, admission seq, record): seq is unique,
+        # so comparison never reaches the record and equal-timestamp
+        # records pop in arrival order.
+        self._heap: List[tuple] = []
+        self._evicted: List[tuple] = []  # depth-overflow force-releases
+        self._seq = 0
+        # Event-time bookkeeping (absolute ms): max timestamp admitted,
+        # and the release frontier — the highest timestamp already handed
+        # to the engine (only ever ahead of the watermark after an
+        # eviction; admission behind it would disorder the engine stream).
+        self.max_seen: Optional[int] = None
+        self.frontier: Optional[int] = None
+        # Per-lane source-offset high-water marks (at-least-once dedup at
+        # admission: the engine sees auto-assigned offsets in release
+        # order, so replay dedup must happen here, on the source offsets).
+        self.source_hw: Dict[int, int] = {}
+        # Loss counters — all zero ⇒ loss-free (README contract).
+        self.late_dropped = 0
+        self.quarantined = 0
+        self.reorder_evictions = 0
+        # Non-loss telemetry.
+        self.admitted = 0
+        self.released = 0
+        self.dead_letter_dropped = 0
+        self.reason_counts: Dict[str, int] = {}
+        self.dead_letters: List[DeadLetter] = []
+
+    # -- admission ----------------------------------------------------------
+
+    @property
+    def watermark(self) -> Optional[int]:
+        """Max admitted timestamp minus the grace (None before any)."""
+        if self.max_seen is None:
+            return None
+        return self.max_seen - self.policy.grace_ms
+
+    def late_by(self, ts: int) -> Optional[int]:
+        """How many ms ``ts`` is behind the release cutoff (None = on
+        time).  Strictly behind: a record AT the watermark (or at an
+        already-released timestamp) still admits, behind its equals."""
+        cutoff = self.watermark
+        if self.frontier is not None:
+            cutoff = self.frontier if cutoff is None else max(
+                cutoff, self.frontier
+            )
+        if cutoff is None or ts >= cutoff:
+            return None
+        return cutoff - ts
+
+    def push(self, record) -> None:
+        """Admit one validated record into the buffer (may force-release
+        the earliest held record when the depth cap is hit)."""
+        ts = int(record.timestamp)
+        heapq.heappush(self._heap, (ts, self._seq, record))
+        self._seq += 1
+        self.admitted += 1
+        self.max_seen = ts if self.max_seen is None else max(
+            self.max_seen, ts
+        )
+        if len(self._heap) > self.policy.reorder_depth:
+            ent = heapq.heappop(self._heap)
+            self._evicted.append(ent)
+            self.reorder_evictions += 1
+            self.frontier = ent[0] if self.frontier is None else max(
+                self.frontier, ent[0]
+            )
+
+    def quarantine(self, record, reason: str, detail: str, corr: str) -> None:
+        """Divert one record to the dead-letter queue with a typed reason."""
+        if reason == REASON_LATE:
+            self.late_dropped += 1
+        else:
+            self.quarantined += 1
+        self.reason_counts[reason] = self.reason_counts.get(reason, 0) + 1
+        if len(self.dead_letters) >= self.policy.dead_letter_cap:
+            self.dead_letters.pop(0)
+            self.dead_letter_dropped += 1
+        self.dead_letters.append(DeadLetter(record, reason, detail, corr))
+        logger.warning(
+            "quarantined record (reason=%s, corr=%s): %s", reason, corr,
+            detail,
+        )
+
+    # -- release ------------------------------------------------------------
+
+    def release(self) -> List:
+        """Records whose timestamps the watermark has passed, in
+        (timestamp, arrival) order — plus any depth-cap evictions, which
+        always precede them (an eviction popped the then-minimum, and
+        later admissions behind it are late-dropped at the door)."""
+        out = self._evicted
+        self._evicted = []
+        wm = self.watermark
+        if wm is not None:
+            while self._heap and self._heap[0][0] <= wm:
+                out.append(heapq.heappop(self._heap))
+        return self._emit(out)
+
+    def drain(self) -> List:
+        """End-of-stream: release everything held, watermark regardless."""
+        out = self._evicted
+        self._evicted = []
+        while self._heap:
+            out.append(heapq.heappop(self._heap))
+        return self._emit(out)
+
+    def _emit(self, entries: List[tuple]) -> List:
+        if entries:
+            self.frontier = entries[-1][0] if self.frontier is None else max(
+                self.frontier, entries[-1][0]
+            )
+            self.released += len(entries)
+        return [rec for _, _, rec in entries]
+
+    # -- telemetry ----------------------------------------------------------
+
+    @property
+    def held(self) -> int:
+        return len(self._heap) + len(self._evicted)
+
+    def hold_age_ms(self) -> int:
+        """Event-time age of the oldest held record (how long the head of
+        the buffer has been waiting relative to the newest admission)."""
+        if not self._heap or self.max_seen is None:
+            return 0
+        return max(0, self.max_seen - self._heap[0][0])
+
+    def loss_counters(self) -> Dict[str, int]:
+        """The loss contract: all zero ⇒ nothing dropped or disordered."""
+        return {
+            "late_dropped": self.late_dropped,
+            "quarantined": self.quarantined,
+            "reorder_evictions": self.reorder_evictions,
+        }
+
+    def stats(self) -> Dict[str, int]:
+        out = dict(self.loss_counters())
+        out.update(
+            ingest_held=self.held,
+            ingest_hold_age_ms=self.hold_age_ms(),
+            ingest_admitted=self.admitted,
+            ingest_released=self.released,
+            dead_letter_depth=len(self.dead_letters),
+            dead_letter_dropped=self.dead_letter_dropped,
+        )
+        if self.watermark is not None:
+            out["ingest_watermark"] = self.watermark
+        return out
+
+    # -- durability ---------------------------------------------------------
+
+    def to_state(self) -> Dict[str, Any]:
+        """Picklable snapshot (checkpoint header payload).  Records and
+        dead letters carry user values — the same pickle contract as the
+        processor's host event mirror."""
+        return {
+            "policy": dataclasses.asdict(self.policy),
+            "heap": list(self._heap),
+            "evicted": list(self._evicted),
+            "seq": self._seq,
+            "max_seen": self.max_seen,
+            "frontier": self.frontier,
+            "source_hw": dict(self.source_hw),
+            "late_dropped": self.late_dropped,
+            "quarantined": self.quarantined,
+            "reorder_evictions": self.reorder_evictions,
+            "admitted": self.admitted,
+            "released": self.released,
+            "dead_letter_dropped": self.dead_letter_dropped,
+            "reason_counts": dict(self.reason_counts),
+            "dead_letters": list(self.dead_letters),
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "IngestGuard":
+        guard = cls(IngestPolicy(**state["policy"]))
+        guard._heap = [tuple(e) for e in state["heap"]]
+        heapq.heapify(guard._heap)
+        guard._evicted = [tuple(e) for e in state["evicted"]]
+        guard._seq = int(state["seq"])
+        guard.max_seen = state["max_seen"]
+        guard.frontier = state["frontier"]
+        guard.source_hw = {int(k): int(v) for k, v in state["source_hw"].items()}
+        guard.late_dropped = int(state["late_dropped"])
+        guard.quarantined = int(state["quarantined"])
+        guard.reorder_evictions = int(state["reorder_evictions"])
+        guard.admitted = int(state["admitted"])
+        guard.released = int(state["released"])
+        guard.dead_letter_dropped = int(state["dead_letter_dropped"])
+        guard.reason_counts = dict(state["reason_counts"])
+        guard.dead_letters = [DeadLetter(*d) for d in state["dead_letters"]]
+        return guard

@@ -314,6 +314,11 @@ def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
     new_proc._value_proto = proc._value_proto
     new_proc._step_base = proc._step_base  # pending-handle ordering base
     new_proc.metrics = proc.metrics  # continuity: one stream, one meter
+    # Ingestion guard (runtime/ingest.py): pure host state — held records,
+    # watermark, dead letters, and loss counters move with the migration
+    # exactly like the event mirror (the engine never saw the held
+    # records, so widening cannot perturb them).
+    new_proc._guard = proc._guard
     logger.info(
         "migrated processor %s -> %s",
         {f: getattr(old_config, f) for f in _SHAPE_DIMS},

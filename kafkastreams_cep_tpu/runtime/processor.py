@@ -37,6 +37,15 @@ from kafkastreams_cep_tpu.engine.matcher import (
     EventBatch,
 )
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+from kafkastreams_cep_tpu.runtime.ingest import (
+    REASON_LANE_OVERFLOW,
+    REASON_LATE,
+    REASON_SCHEMA,
+    REASON_TIME_RANGE,
+    Defect,
+    IngestGuard,
+    IngestPolicy,
+)
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 from kafkastreams_cep_tpu.utils.metrics import Metrics, device_memory_stats
@@ -136,6 +145,7 @@ class CEPProcessor:
         trace_sink: Optional[TraceSink] = None,
         name: Optional[str] = None,
         drain_interval: int = 1,
+        ingest: Optional[IngestPolicy] = None,
     ):
         # ``mesh``: a ``jax.sharding.Mesh`` shards the lane axis over the
         # devices (state-follows-partition, ``CEPProcessor.java:117-134`` —
@@ -226,6 +236,16 @@ class CEPProcessor:
         # ms), for the watermark / event-time-lag gauges in
         # ``metrics_snapshot`` — the ``records-lag`` analog.
         self._watermark: Optional[int] = None
+        # Ingestion guard (runtime/ingest.py): a watermark-driven reorder
+        # buffer + per-record quarantine in front of the engine.  None (the
+        # default) keeps the historical batch-atomic front door: any bad
+        # record raises InputRejected for the whole batch, and arrival
+        # order is the engine order.  With a policy, records are validated
+        # per record (defects dead-lettered, or raised under
+        # ``on_bad_record="raise"``), held until the watermark passes them,
+        # and released to the engine in timestamp order with auto-assigned
+        # engine offsets; source offsets drive replay dedup at admission.
+        self._guard = IngestGuard(ingest) if ingest is not None else None
 
     # -- key -> lane assignment (partition-assignment analog) ---------------
 
@@ -236,8 +256,9 @@ class CEPProcessor:
         lane = len(self._lane_of)
         if lane >= self.num_lanes:
             raise InputRejected(
-                f"more than num_lanes={self.num_lanes} distinct keys; "
-                f"size the processor for the key cardinality it serves"
+                f"key {key!r}: more than num_lanes={self.num_lanes} "
+                "distinct keys; size the processor for the key "
+                "cardinality it serves"
             )
         self._lane_of[key] = lane
         self._key_of[lane] = key
@@ -249,14 +270,15 @@ class CEPProcessor:
             return int(key)
         return lane
 
-    def _rebased_ts(self, timestamp: int) -> int:
+    def _rebased_ts(self, timestamp: int, rank: int = -1, key=None) -> int:
         rel = int(timestamp) - self.epoch
         if not (_I32.min <= rel <= _I32.max):
+            where = f"record {rank} (key {key!r}): " if rank >= 0 else ""
             raise InputRejected(
-                f"timestamp {timestamp} is {rel} ms from the processor epoch "
-                f"{self.epoch}, outside int32 device time (~±24.8 days); "
-                "construct the processor with an epoch near your stream's "
-                "timestamps"
+                f"{where}timestamp {timestamp} is {rel} ms from the "
+                f"processor epoch {self.epoch}, outside int32 device time "
+                "(~±24.8 days); construct the processor with an epoch near "
+                "your stream's timestamps"
             )
         return rel
 
@@ -279,12 +301,158 @@ class CEPProcessor:
             records=len(records),
         ) as sp:
             with self._phase("pack"):
-                packed = self._pack_records(records)
+                if self._guard is not None:
+                    released = self._ingest(
+                        list(records), f"{self.name}-{self._batch_seq}"
+                    )
+                    sp["released"] = len(released)
+                    packed = (
+                        self._pack_records(released) if released else None
+                    )
+                else:
+                    packed = self._pack_records(records)
             if packed is None:
                 return []
             events, rank_of, n_kept = packed
             sp["lanes"] = len(self._lane_of)
             matches = self._dispatch(events, rank_of, n_kept)
+            sp["matches"] = len(matches)
+            return matches
+
+    # -- the ingestion guard (runtime/ingest.py) ---------------------------
+
+    def _ingest(self, records: List[Record], corr: str) -> List[Record]:
+        """Admit one raw batch through the guard; returns the released
+        (watermark-passed, timestamp-ordered) records with engine offsets
+        reset to auto — release order IS the engine's log order, and the
+        source offsets already did their job (dedup at admission)."""
+        guard = self._guard
+        # Fault site: before any guard or lane bookkeeping mutates — the
+        # batch is rejected wholesale, nothing half-admitted.
+        _failpoint("ingest.admit")
+        strict = guard.policy.on_bad_record == "raise"
+        for idx, rec in enumerate(records):
+            defect = self._record_defect(rec)
+            if defect is None:
+                guard.push(rec)
+                continue
+            if defect.silent:
+                self.metrics.duplicates_dropped += 1
+                continue
+            if strict:
+                raise InputRejected(
+                    f"record {idx} (key {rec.key!r}): {defect.reason}: "
+                    f"{defect.detail}"
+                )
+            guard.quarantine(rec, defect.reason, defect.detail, corr)
+        released = guard.release()
+        # Fault site: the adversarial window — the buffer already moved
+        # (records admitted, releases popped) but the engine never saw
+        # them.  Recovery must restore the buffer from the snapshot and
+        # re-admit from the journal (chaos-tested).
+        _failpoint("ingest.release")
+        return [
+            r._replace(offset=None) if r.offset is not None else r
+            for r in released
+        ]
+
+    def _record_defect(self, rec: Record) -> Optional[Defect]:
+        """Validate ONE record against the schema/lane/time contracts the
+        batch path enforces atomically; commits schema, epoch, and lane
+        assignment on first sight (the guard admits per record, so there
+        is no batch to reject).  Returns None when admissible."""
+        guard = self._guard
+        if self._value_proto is None:
+            leaves0, treedef0 = jax.tree_util.tree_flatten(rec.value)
+            self._value_proto = jax.tree_util.tree_unflatten(
+                treedef0,
+                [
+                    np.dtype(np.float32)
+                    if np.issubdtype(np.asarray(l).dtype, np.floating)
+                    else np.dtype(np.int32)
+                    for l in leaves0
+                ],
+            )
+        dtypes, treedef = jax.tree_util.tree_flatten(self._value_proto)
+        leaves, rec_def = jax.tree_util.tree_flatten(rec.value)
+        if rec_def != treedef:
+            return Defect(
+                REASON_SCHEMA,
+                f"value structure {rec_def} differs from the schema "
+                f"{treedef} fixed by the first record",
+            )
+        for field_i, (leaf, dt) in enumerate(zip(leaves, dtypes)):
+            if np.issubdtype(np.asarray(leaf).dtype, np.floating) and not (
+                np.issubdtype(dt, np.floating)
+            ):
+                return Defect(
+                    REASON_SCHEMA,
+                    f"field #{field_i}: float value {leaf!r} in a field "
+                    "the schema (fixed by the first record) typed as int",
+                )
+        lane = self._lane_of.get(rec.key)
+        if lane is None:
+            if len(self._lane_of) >= self.num_lanes:
+                return Defect(
+                    REASON_LANE_OVERFLOW,
+                    f"key {rec.key!r} would exceed num_lanes="
+                    f"{self.num_lanes}; size the processor for the key "
+                    "cardinality it serves",
+                )
+            lane = len(self._lane_of)
+            self._lane_of[rec.key] = lane
+            self._key_of[lane] = rec.key
+            logger.info("assigned key %r to lane %d", rec.key, lane)
+        if self.epoch is None:
+            self.epoch = int(rec.timestamp)
+        rel = int(rec.timestamp) - self.epoch
+        if not (_I32.min <= rel <= _I32.max):
+            return Defect(
+                REASON_TIME_RANGE,
+                f"timestamp {rec.timestamp} is {rel} ms from the processor "
+                f"epoch {self.epoch}, outside int32 device time "
+                "(~±24.8 days)",
+            )
+        if rec.offset is not None:
+            hw = guard.source_hw.get(lane, 0)
+            if self.dedup and rec.offset < hw:
+                return Defect("duplicate", "", silent=True)
+            guard.source_hw[lane] = max(hw, int(rec.offset) + 1)
+        behind = guard.late_by(int(rec.timestamp))
+        if behind is not None:
+            return Defect(
+                REASON_LATE,
+                f"timestamp {rec.timestamp} is {behind} ms behind the "
+                f"watermark {guard.watermark} (grace "
+                f"{guard.policy.grace_ms} ms)",
+            )
+        return None
+
+    def drain_ingest(self) -> List[Tuple[Hashable, Sequence]]:
+        """End-of-stream drain of the reorder buffer: release every held
+        record regardless of watermark (the stream is declared over, so
+        nothing younger can still arrive) and run them through the
+        engine.  A no-op without a guard or with an empty buffer.  Call
+        :meth:`flush` afterwards for pipelined / lazy processors."""
+        if self._guard is None:
+            return []
+        released = self._guard.drain()
+        if not released:
+            return []
+        released = [
+            r._replace(offset=None) if r.offset is not None else r
+            for r in released
+        ]
+        self._batch_seq += 1
+        with maybe_span(
+            self.trace, "batch", path="ingest-drain", batch=self._batch_seq,
+            records=len(released),
+        ) as sp:
+            with self._phase("pack"):
+                packed = self._pack_records(released)
+            if packed is None:
+                return []
+            matches = self._dispatch(*packed)
             sp["matches"] = len(matches)
             return matches
 
@@ -317,19 +485,22 @@ class CEPProcessor:
         # high-water mark are duplicates (at-least-once replay) and dropped.
         lane_sim = dict(self._lane_of)
         lanes = []
-        for rec in records:
+        for rank, rec in enumerate(records):
             lane = lane_sim.get(rec.key)
             if lane is None:
                 lane = len(lane_sim)
                 if lane >= self.num_lanes:
                     raise InputRejected(
-                        f"more than num_lanes={self.num_lanes} distinct "
-                        "keys; size the processor for the key cardinality "
-                        "it serves"
+                        f"record {rank} (key {rec.key!r}): more than "
+                        f"num_lanes={self.num_lanes} distinct keys; size "
+                        "the processor for the key cardinality it serves"
                     )
                 lane_sim[rec.key] = lane
             lanes.append(lane)
-        rel_ts = [self._rebased_ts(rec.timestamp) for rec in records]
+        rel_ts = [
+            self._rebased_ts(rec.timestamp, rank, rec.key)
+            for rank, rec in enumerate(records)
+        ]
         next_sim = self._next_offset.copy()
         base_sim = self._off_base.copy()
         offsets: List[Optional[int]] = []
@@ -338,14 +509,16 @@ class CEPProcessor:
             leaves = jax.tree_util.tree_leaves(rec.value)
             if len(leaves) != len(dtypes):
                 raise InputRejected(
-                    f"record {rank}: value structure differs from the "
-                    "schema fixed by the first record"
+                    f"record {rank} (key {rec.key!r}): value structure "
+                    f"({len(leaves)} fields) differs from the schema fixed "
+                    f"by the first record ({len(dtypes)} fields)"
                 )
-            for leaf, dt in zip(leaves, dtypes):
+            for field_i, (leaf, dt) in enumerate(zip(leaves, dtypes)):
                 if np.issubdtype(np.asarray(leaf).dtype, np.floating) and not np.issubdtype(dt, np.floating):
                     raise InputRejected(
-                        f"record {rank}: float value {leaf!r} in a field the "
-                        "schema (fixed by the first record) typed as int"
+                        f"record {rank} (key {rec.key!r}): field #{field_i} "
+                        f"float value {leaf!r} in a field the schema (fixed "
+                        "by the first record) typed as int"
                     )
             batch_leaves.append(leaves)
             lane = lanes[rank]
@@ -358,15 +531,17 @@ class CEPProcessor:
                 dev = off - int(base_sim[lane])
                 if dev < 0:
                     raise InputRejected(
-                        f"record {rank}: offset {off} is below lane "
-                        f"{lane}'s base {int(base_sim[lane])} (out-of-order "
-                        "replay below the first seen offset needs dedup=True)"
+                        f"record {rank} (key {rec.key!r}): offset {off} is "
+                        f"below lane {lane}'s base {int(base_sim[lane])} "
+                        "(out-of-order replay below the first seen offset "
+                        "needs dedup=True)"
                     )
                 if dev >= OFFSET_LIMIT:
                     raise InputRejected(
-                        f"record {rank}: offset {off} is {dev} past lane "
-                        f"{lane}'s base — per-lane log positions must stay "
-                        f"below 2^24 (engine f32 pointer packing)"
+                        f"record {rank} (key {rec.key!r}): offset {off} is "
+                        f"{dev} past lane {lane}'s base — per-lane log "
+                        "positions must stay below 2^24 (engine f32 "
+                        "pointer packing)"
                     )
                 offsets.append(off)
                 next_sim[lane] = max(next_sim[lane], off + 1)
@@ -477,6 +652,13 @@ class CEPProcessor:
         needs the per-record path.  Emitted Events carry values rebuilt
         from the packed columns (schema dtypes), not the caller's original
         scalars."""
+        if self._guard is not None:
+            raise ValueError(
+                "the ingestion guard runs on the per-record path only; "
+                "process_columns bypasses per-record validation and the "
+                "reorder buffer (construct the processor without "
+                "ingest=... to use the columnar path)"
+            )
         self._batch_seq += 1
         with maybe_span(
             self.trace, "batch", path="columns", batch=self._batch_seq,
@@ -531,16 +713,18 @@ class CEPProcessor:
                 "value columns structure differs from the schema fixed by "
                 "the first batch"
             )
-        for l, dt in zip(leaves_in, dtypes):
+        for field_i, (l, dt) in enumerate(zip(leaves_in, dtypes)):
             if l.shape != (n,):
                 raise InputRejected(
-                    f"value column shape {l.shape} != ({n},)"
+                    f"field #{field_i}: value column shape {l.shape} != "
+                    f"({n},)"
                 )
             if np.issubdtype(l.dtype, np.floating) and not np.issubdtype(
                 dt, np.floating
             ):
                 raise InputRejected(
-                    "float column in a field the schema typed as int"
+                    f"field #{field_i}: float column in a field the "
+                    "schema typed as int"
                 )
 
         # Lane mapping, committed atomically after the overflow check.
@@ -552,7 +736,8 @@ class CEPProcessor:
         new = [k for k in uniq if k not in self._lane_of]
         if len(self._lane_of) + len(new) > K:
             raise InputRejected(
-                f"more than num_lanes={K} distinct keys; size the "
+                f"more than num_lanes={K} distinct keys (first overflowing "
+                f"key: {new[K - len(self._lane_of)]!r}); size the "
                 "processor for the key cardinality it serves"
             )
         for k in new:
@@ -575,9 +760,13 @@ class CEPProcessor:
 
         rel = ts_arr - self.epoch
         if rel.size and (rel.min() < _I32.min or rel.max() > _I32.max):
+            bad = int(
+                np.argmax((rel < _I32.min) | (rel > _I32.max))
+            )
             raise InputRejected(
-                "timestamps outside int32 device time relative to the "
-                f"processor epoch {self.epoch}"
+                f"record {bad} (key {keys_arr[bad]!r}): timestamp "
+                f"{int(ts_arr[bad])} outside int32 device time relative "
+                f"to the processor epoch {self.epoch}"
             )
         wm = int(ts_arr.max())
         self._watermark = wm if self._watermark is None else max(self._watermark, wm)
@@ -1012,6 +1201,13 @@ class CEPProcessor:
             if self._watermark is not None
             else None
         )
+        if self._guard is not None:
+            # Guard telemetry: the three loss counters (all-zero ⇒
+            # loss-free), hold depth/age gauges, and per-reason
+            # dead-letter counts (rendered with reason labels by
+            # utils/telemetry.render_prometheus).
+            snap.update(self._guard.stats())
+            snap["dead_letters"] = dict(self._guard.reason_counts)
         snap["per_pattern"] = {
             self.name: {
                 **self.counters(),

@@ -40,6 +40,7 @@ import itertools
 import os
 import pickle
 import tempfile
+import time
 from dataclasses import dataclass, field
 from typing import Hashable, List, Optional, Sequence as Seq, Tuple
 
@@ -156,6 +157,8 @@ class Supervisor:
         journal_path: Optional[str] = None,
         journal_sync: bool = False,
         auto_escalate=False,
+        retry_backoff_ms: float = 50.0,
+        retry_backoff_cap_ms: float = 5000.0,
         processor: Optional[CEPProcessor] = None,
         _resuming: bool = False,
         **proc_kwargs,
@@ -181,6 +184,16 @@ class Supervisor:
         )
         self.checkpoint_every = int(checkpoint_every)
         self.max_retries = int(max_retries)
+        # Exponential retry backoff with deterministic jitter: a device
+        # fault that survives the instant retry is usually environmental
+        # (reset storm, tunnel flap), and hammering it back-to-back turns
+        # one fault into a fault train.  Jitter derives from (seq,
+        # attempt) so a given retry always waits the same time —
+        # reproducible chaos runs.  Tests patch ``self._sleep``.
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.retry_backoff_cap_ms = float(retry_backoff_cap_ms)
+        self.retry_backoff_ms_total = 0.0
+        self._sleep = time.sleep
         self._journal: List[List[Record]] = []  # batches since last ckpt
         self._disk_journal = (
             Journal(journal_path, sync=journal_sync) if journal_path else None
@@ -203,6 +216,10 @@ class Supervisor:
                     journal_path,
                 )
                 self._disk_journal.truncate()
+            if self._disk_journal is not None and os.path.exists(
+                journal_path + ".prev"
+            ):
+                os.remove(journal_path + ".prev")
             if os.path.exists(self.checkpoint_path):
                 logger.warning(
                     "checkpoint %s belongs to a previous run; removing "
@@ -210,6 +227,8 @@ class Supervisor:
                     self.checkpoint_path,
                 )
                 os.remove(self.checkpoint_path)
+            if os.path.exists(self.checkpoint_path + ".prev"):
+                os.remove(self.checkpoint_path + ".prev")
         self._has_checkpoint = False
         self._batches_since_ckpt = 0
         # Monotone batch sequence number: stamped into journal frames and
@@ -222,6 +241,10 @@ class Supervisor:
         self.checkpoint_failures = 0
         self.journal_failures = 0
         self.escalations = 0
+        self.ingest_escalations = 0
+        # Ingest-loss escalation baseline (guard counters are cumulative,
+        # like the engine capacity counters).
+        self._ingest_base: Optional[dict] = None
         # Escalation bookkeeping: capacity counters are cumulative, so
         # trips are detected on the per-batch DELTA against this snapshot
         # (refreshed after every batch / recovery / migration).
@@ -258,23 +281,42 @@ class Supervisor:
         """Rebuild a supervisor after a process crash.
 
         Restores ``checkpoint_path`` if the file exists (else starts
-        fresh), then replays the on-disk journal's intact prefix —
+        fresh), then replays the on-disk journal chain's intact prefix —
         deterministic, so the processor lands exactly where the crashed
         process left off; replayed matches are suppressed (the old process
         already emitted them).  Journal frames carry the batch sequence
         number, and frames at or below the checkpoint's sequence are
-        skipped — so a crash *between* snapshotting and journal truncation
+        skipped — so a crash *between* snapshotting and journal rotation
         cannot double-replay the snapshotted batches.
+
+        A snapshot that fails its integrity check (``checkpoint.py``
+        sha256 — bit rot, torn write) does not crash the resume: the
+        previous-good ``.prev`` snapshot is restored instead (or a fresh
+        processor when the corrupt one was the first), and the journal
+        chain (``.prev`` frames + live frames, one generation retained
+        per snapshot) replays the full gap.
         """
         proc = None
         base_seq = 0
-        if checkpoint_path and os.path.exists(checkpoint_path):
-            ckpt = ckpt_mod.load_checkpoint(checkpoint_path)
-            base_seq = int(ckpt["header"].get("extra", {}).get("seq", 0))
-            proc = ckpt_mod.restore_processor(
-                pattern, checkpoint_path, ckpt=ckpt,
-                mesh=kwargs.get("mesh"),
-            )
+        candidates = []
+        if checkpoint_path:
+            candidates = [
+                p for p in (checkpoint_path, checkpoint_path + ".prev")
+                if os.path.exists(p)
+            ]
+        for path in candidates:
+            try:
+                ckpt = ckpt_mod.load_checkpoint(path)
+                proc = ckpt_mod.restore_processor(
+                    pattern, path, ckpt=ckpt, mesh=kwargs.get("mesh"),
+                )
+                base_seq = int(ckpt["header"].get("extra", {}).get("seq", 0))
+                break
+            except ckpt_mod.CheckpointCorrupt:
+                logger.exception(
+                    "checkpoint %s is corrupt; falling back (journal-chain "
+                    "replay covers the gap)", path,
+                )
         sup = cls(
             pattern, num_lanes, config,
             checkpoint_path=checkpoint_path,
@@ -289,33 +331,46 @@ class Supervisor:
         sup.processor.trace = sup.trace
         replayed = skipped = 0
         if sup._disk_journal is not None:
-            for payload in sup._disk_journal.replay():
-                seq, batch = pickle.loads(payload)
-                if seq <= base_seq:
-                    skipped += 1  # already inside the snapshot
-                    continue
-                if seq != sup._seq + 1:
-                    # Defense in depth: a seq gap means the journal is not
-                    # a complete history (it should be impossible — a
-                    # failed append suspends journaling).  Replaying past
-                    # the gap would build a state that never saw the
-                    # missing batches; stop at the last contiguous frame.
-                    logger.error(
-                        "journal seq gap (%d -> %d); stopping replay at "
-                        "the last contiguous frame", sup._seq, seq,
-                    )
+            # The chain: the retired ``.prev`` generation first (frames at
+            # or below the LIVE snapshot's seq — needed only when that
+            # snapshot was corrupt and the fallback rewound base_seq),
+            # then the live journal.
+            gap = False
+            for jr in (
+                Journal(journal_path + ".prev"), sup._disk_journal,
+            ):
+                for payload in jr.replay():
+                    seq, batch = pickle.loads(payload)
+                    if seq <= base_seq:
+                        skipped += 1  # already inside the snapshot
+                        continue
+                    if seq != sup._seq + 1:
+                        # Defense in depth: a seq gap means the journal is
+                        # not a complete history (it should be impossible —
+                        # a failed append suspends journaling).  Replaying
+                        # past the gap would build a state that never saw
+                        # the missing batches; stop at the last contiguous
+                        # frame.
+                        logger.error(
+                            "journal seq gap (%d -> %d); stopping replay at "
+                            "the last contiguous frame", sup._seq, seq,
+                        )
+                        gap = True
+                        break
+                    sup.processor.process(batch)  # matches already emitted
+                    sup._journal.append(batch)
+                    sup._batches_since_ckpt += 1
+                    sup._seq = seq
+                    replayed += len(batch)
+                if gap:
                     break
-                sup.processor.process(batch)  # matches already emitted
-                sup._journal.append(batch)
-                sup._batches_since_ckpt += 1
-                sup._seq = seq
-                replayed += len(batch)
         # Pipelined replay leaves the last batch undecoded: drain it
         # (suppressed — the crashed process already emitted it) so it
         # cannot leak out of the first post-resume process() call.
         sup.processor.flush()
         if sup._policy is not None:
             sup._counter_base = sup._capacity_counters()
+            sup._ingest_base = sup._ingest_loss_counters()
         logger.info(
             "resumed from %s + %s: %d journaled records replayed "
             "(%d pre-snapshot frames skipped)",
@@ -348,19 +403,64 @@ class Supervisor:
             # Fault site: the crash window between writing the tmp snapshot
             # and atomically installing it (utils/failpoints.py).
             _failpoint("checkpoint.rename")
+            # One-generation retention: the outgoing snapshot survives as
+            # ``.prev`` and the outgoing journal as ``.prev`` frames, so
+            # a snapshot that later fails its integrity check (bit rot —
+            # checkpoint.py sha256) can fall back to the previous-good
+            # snapshot with the journal CHAIN covering the full gap.
+            if os.path.exists(self.checkpoint_path):
+                os.replace(
+                    self.checkpoint_path, self.checkpoint_path + ".prev"
+                )
             os.replace(tmp, self.checkpoint_path)
             self._has_checkpoint = True
             self._journal.clear()
             if self._disk_journal is not None:
-                self._disk_journal.truncate()
+                self._rotate_journal()
                 self._journal_suspended = False  # clean base re-established
             self._batches_since_ckpt = 0
             self.checkpoints += 1
         return self._drain_unclaimed()
 
+    def _rotate_journal(self) -> None:
+        """Retire the journal's frames into ``.prev`` (all covered by the
+        snapshot just installed; kept one generation for the corrupt-
+        snapshot fallback) and start the live journal empty."""
+        jr = self._disk_journal.path
+        if os.path.exists(jr):
+            os.replace(jr, jr + ".prev")
+        else:
+            # Nothing to retire, but a stale .prev from two checkpoints
+            # ago must not linger past its snapshot.
+            try:
+                os.remove(jr + ".prev")
+            except FileNotFoundError:
+                pass
+
     def _drain_unclaimed(self) -> List[Tuple[Hashable, Sequence]]:
         out, self._unclaimed = self._unclaimed, []
         return out
+
+    def drain_ingest(self) -> List[Tuple[Hashable, Sequence]]:
+        """End-of-stream drain of the ingestion guard's reorder buffer,
+        made durable: the drain dispatch is not journaled (it has no
+        input batch a replay could reproduce), so the post-drain state is
+        pinned with an immediate snapshot — a crash after this call
+        resumes with the buffer empty and the drained matches already
+        emitted, never double-emitted.  Terminal by convention: call when
+        the stream is declared over."""
+        matches = self.processor.drain_ingest()
+        matches += self.processor.flush()
+        try:
+            matches = matches + self.checkpoint()
+        except Exception:
+            self.checkpoint_failures += 1
+            logger.exception(
+                "post-drain checkpoint failed; a resume will re-drain "
+                "(the drained matches were already emitted — re-submit "
+                "nothing, the journal still covers the pre-drain state)"
+            )
+        return matches
 
     # -- the supervised hot path -------------------------------------------
 
@@ -410,6 +510,7 @@ class Supervisor:
                     len(records),
                 )
                 self._recover(corr)
+                self._backoff(attempt)
         if self._policy is not None:
             matches = self._maybe_escalate(records, matches, had_pending, corr)
         self._journal.append(records)
@@ -459,11 +560,33 @@ class Supervisor:
             except Exception:
                 self.checkpoint_failures += 1
                 logger.exception("checkpoint failed; journal retained")
+        if self._policy is not None:
+            self._maybe_escalate_ingest()
         if self._unclaimed:
             # A failed snapshot above still flushed the pipeline; those
             # matches belong to the caller either way.
             matches = matches + self._drain_unclaimed()
         return matches
+
+    def _backoff(self, attempt: int) -> None:
+        """Sleep before re-dispatching a faulted batch: exponential in the
+        attempt, capped, with deterministic jitter — ``(seq, attempt)``
+        seeds the jitter so a replayed chaos schedule waits identically.
+        ``retry_backoff_ms=0`` disables (the historical immediate retry).
+        """
+        if self.retry_backoff_ms <= 0:
+            return
+        delay_ms = min(
+            self.retry_backoff_cap_ms,
+            self.retry_backoff_ms * (2.0 ** attempt),
+        )
+        rng = np.random.default_rng((self._seq + 1, attempt))
+        delay_ms *= 0.5 + 0.5 * float(rng.random())  # jitter in [0.5, 1.0)
+        self.retry_backoff_ms_total += delay_ms
+        logger.info(
+            "retry backoff: %.1f ms before attempt %d", delay_ms, attempt + 2
+        )
+        self._sleep(delay_ms / 1000.0)
 
     def _restore_tail(self) -> int:
         """Restore the last checkpoint and replay the journal tail.
@@ -475,10 +598,23 @@ class Supervisor:
         Shared by failure recovery and escalation rollback.
         """
         if self._has_checkpoint:
-            self.processor = ckpt_mod.restore_processor(
-                self._pattern, self.checkpoint_path,
-                mesh=self._proc_kwargs.get("mesh"),
-            )
+            try:
+                self.processor = ckpt_mod.restore_processor(
+                    self._pattern, self.checkpoint_path,
+                    mesh=self._proc_kwargs.get("mesh"),
+                )
+            except ckpt_mod.CheckpointCorrupt:
+                # Same fallback order as resume(): the previous-good
+                # snapshot; the in-memory journal of a supervisor that
+                # restored from .prev covers everything since it.
+                logger.exception(
+                    "checkpoint %s is corrupt during recovery; restoring "
+                    "the previous-good snapshot", self.checkpoint_path,
+                )
+                self.processor = ckpt_mod.restore_processor(
+                    self._pattern, self.checkpoint_path + ".prev",
+                    mesh=self._proc_kwargs.get("mesh"),
+                )
             # Checkpoints carry no telemetry wiring: reattach the trace
             # sink so post-recovery batches keep emitting spans.
             self.processor.trace = self.trace
@@ -515,6 +651,7 @@ class Supervisor:
         # delta would be measured against the pre-failure accumulation.
         if self._policy is not None:
             self._counter_base = self._capacity_counters()
+            self._ingest_base = self._ingest_loss_counters()
         logger.info(
             "recovered: checkpoint=%s, %d journaled records replayed",
             self._has_checkpoint, replayed,
@@ -524,6 +661,12 @@ class Supervisor:
 
     def _capacity_counters(self) -> dict:
         return sizing.capacity_counters(self.processor.counters())
+
+    def _ingest_loss_counters(self) -> dict:
+        guard = getattr(self.processor, "_guard", None)
+        if guard is None:
+            return {}
+        return sizing.ingest_capacity_counters(guard.loss_counters())
 
     def _maybe_escalate(
         self, records, matches, had_pending: bool = False,
@@ -655,6 +798,60 @@ class Supervisor:
         self._trip_streak = 0
         return kept + rerun
 
+    def _maybe_escalate_ingest(self) -> None:
+        """Grow the ingestion-guard policy when a batch tripped an
+        ingest loss counter (``sizing.escalate_ingest`` rows: late drops
+        grow the grace, evictions grow the buffer depth).
+
+        Forward-only, unlike engine escalation: the dropped records are
+        already dead-lettered (recoverable by the caller from the DLQ),
+        and re-processing them would require re-ordering history the
+        engine has moved past — widening stops the loss for the rest of
+        the stream.  The widened policy is pinned with an immediate
+        snapshot so recoveries and resumes replay under it.
+        """
+        guard = getattr(self.processor, "_guard", None)
+        if guard is None:
+            return
+        counters = self._ingest_loss_counters()
+        base = self._ingest_base
+        if base is None:
+            base = {k: 0 for k in counters}
+        tripped = positive_delta(counters, base)
+        self._ingest_base = counters
+        if not tripped:
+            return
+        new_policy = sizing.escalate_ingest(
+            guard.policy, tripped, growth=self._policy.growth
+        )
+        if new_policy is None:
+            logger.warning(
+                "ingest loss %s but the guard policy cannot grow; records "
+                "remain in the dead-letter queue", tripped,
+            )
+            return
+        old = guard.policy
+        guard.policy = new_policy
+        self.ingest_escalations += 1
+        logger.warning(
+            "ingest escalation #%d: grace_ms %d -> %d, reorder_depth "
+            "%d -> %d after loss %s (already-dropped records stay in the "
+            "dead-letter queue)",
+            self.ingest_escalations, old.grace_ms, new_policy.grace_ms,
+            old.reorder_depth, new_policy.reorder_depth, tripped,
+        )
+        try:
+            # checkpoint() returns any pipeline-flush matches; they belong
+            # to the caller via the _unclaimed drain in process().
+            self._unclaimed.extend(self.checkpoint())
+        except Exception:
+            self.checkpoint_failures += 1
+            logger.exception(
+                "post-ingest-escalation checkpoint failed; a recovery "
+                "before the next good snapshot replays under the OLD "
+                "ingest policy"
+            )
+
     # -- diagnostics --------------------------------------------------------
 
     def health(self) -> HealthReport:
@@ -673,6 +870,8 @@ class Supervisor:
         out["checkpoint_failures"] = self.checkpoint_failures
         out["journal_failures"] = self.journal_failures
         out["escalations"] = self.escalations
+        out["ingest_escalations"] = self.ingest_escalations
+        out["retry_backoff_ms_total"] = round(self.retry_backoff_ms_total, 3)
         phases = dict(out.get("phases") or {})
         phases.update(
             {

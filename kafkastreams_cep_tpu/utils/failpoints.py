@@ -42,6 +42,14 @@ Sites currently threaded through the runtime:
 ``checkpoint.rename``  between the tmp-file write and the atomic
                        ``os.replace`` — the crash window the ``.tmp``
                        protocol exists for
+``ingest.admit``       entry of ``CEPProcessor._ingest`` — before any
+                       guard or lane bookkeeping mutates; the batch is
+                       rejected wholesale, nothing half-admitted
+``ingest.release``     after the reorder buffer moved (records admitted,
+                       releases popped) but before the engine dispatch —
+                       the adversarial window: the held set advanced
+                       while device state did not, so recovery must
+                       restore the buffer from the snapshot + journal
 =====================  ====================================================
 """
 
@@ -203,6 +211,10 @@ SITES = (
     "journal.fsync",
     "checkpoint.save",
     "checkpoint.rename",
+    # Ingestion-guard sites (append-only: schedules index by site name,
+    # and random_schedule seeds by position — see the docstring table).
+    "ingest.admit",
+    "ingest.release",
 )
 
 

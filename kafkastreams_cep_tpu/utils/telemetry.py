@@ -505,6 +505,7 @@ def render_prometheus(
     Structural keys get labels instead of name-mangling:
     ``per_lane``  -> ``{lane="i"}``, ``per_pattern`` -> ``{pattern="name"}``,
     ``phases``    -> ``<prefix>_phase_seconds{phase="name"}`` histograms,
+    ``dead_letters`` -> ``<prefix>_dead_letters_total{reason="late"}``,
     ``hbm``       -> ``<prefix>_hbm_<stat>`` gauges.  Histogram snapshots
     render as cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``.
     ``None`` values are skipped (absent, not zero).
@@ -544,6 +545,15 @@ def render_prometheus(
                             v,
                             f'{{lane="{lane}"}}',
                         )
+        elif key == "dead_letters" and isinstance(val, dict):
+            # Ingestion-guard quarantine counts by typed reason
+            # (runtime/ingest.py): one labeled series per reason.
+            for reason in sorted(val):
+                scalar(
+                    f"{prefix}_dead_letters_total",
+                    val[reason],
+                    f'{{reason="{reason}"}}',
+                )
         elif key == "per_pattern" and isinstance(val, dict):
             for pat in sorted(val):
                 sub = val[pat]

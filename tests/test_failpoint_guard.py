@@ -1,0 +1,56 @@
+"""Tier-1 guard: every registered failpoint site must be exercised.
+
+``utils/failpoints.py`` only has value if each named site is actually
+driven to failure by some test — a site added with production wiring but
+no arming test is dead code on the exact path that matters (the failure
+path).  This walks ``SITES`` and greps ``tests/`` for each name, so a
+new site (like the ingest ones) cannot land unexercised, and a renamed
+site cannot silently orphan its schedules.
+"""
+
+import pathlib
+
+from kafkastreams_cep_tpu.utils import failpoints as fp
+
+_THIS = pathlib.Path(__file__)
+
+
+def _tests_corpus() -> str:
+    return "\n".join(
+        p.read_text()
+        for p in _THIS.parent.glob("*.py")
+        if p.name != _THIS.name
+    )
+
+
+def test_every_registered_site_is_armed_by_some_test():
+    corpus = _tests_corpus()
+    unexercised = [
+        site for site in fp.SITES if f'"{site}"' not in corpus
+    ]
+    assert not unexercised, (
+        f"failpoint sites {unexercised} are registered in "
+        "utils/failpoints.py SITES but no test names them — arm each new "
+        "site in at least one test before landing it"
+    )
+
+
+def test_sites_registry_matches_production_fire_calls():
+    """The reverse direction: every ``fire("...")`` call site in the
+    package must be a registered name — a typo'd site would silently
+    never fire under any schedule."""
+    import re
+
+    pkg = _THIS.parent.parent / "kafkastreams_cep_tpu"
+    called = set()
+    for p in pkg.rglob("*.py"):
+        for m in re.finditer(
+            r"_failpoint\(\s*[\"']([a-z_.]+)[\"']\s*\)", p.read_text()
+        ):
+            called.add(m.group(1))
+    assert called, "no production failpoint call sites found"
+    unknown = called - set(fp.SITES)
+    assert not unknown, (
+        f"production fire() sites {sorted(unknown)} are not in "
+        "failpoints.SITES — register them (append-only)"
+    )

@@ -2,14 +2,18 @@
 
 Three windows a process crash can land in, each with a distinct contract:
 
-* between ``save_checkpoint`` and journal ``truncate()`` — the journal
-  still holds frames the snapshot already contains; resume must skip
-  frames at/below the snapshot seq (no double replay);
+* between ``save_checkpoint`` and the journal rotation — the live
+  journal still holds frames the snapshot already contains; resume must
+  skip frames at/below the snapshot seq (no double replay);
 * a seq gap in the journal (a lost frame with later frames present) —
   replay must stop at the last contiguous frame, never build a state
   that skipped history;
 * a torn tail (crash mid-append) — replay repairs the file, and a
-  SECOND crash/resume cycle on the repaired journal stays consistent.
+  SECOND crash/resume cycle on the repaired journal stays consistent;
+* a corrupt snapshot (bit rot after a good save — ISSUE 5 satellite) —
+  the sha256 integrity check fails loudly, and resume falls back to the
+  previous-good snapshot (or fresh) with the journal CHAIN (``.prev``
+  generation + live frames) replaying the full gap instead of crashing.
 """
 
 import pickle
@@ -51,10 +55,10 @@ def assert_same_state(a, b):
         )
 
 
-def test_crash_between_snapshot_and_truncate_skips_contained_frames(
+def test_crash_between_snapshot_and_rotation_skips_contained_frames(
     tmp_path, monkeypatch
 ):
-    """Checkpoint written, journal NOT yet truncated, crash: the journal
+    """Checkpoint written, journal NOT yet rotated, crash: the journal
     frames at/below the snapshot seq must be skipped on resume — the
     no-double-replay half of the seq protocol."""
     values = [sc.A, sc.B, sc.C, sc.A, sc.B]
@@ -68,8 +72,8 @@ def test_crash_between_snapshot_and_truncate_skips_contained_frames(
     for b in batches_for(values[:3]):
         emitted += sup.process(b)
     assert len(emitted) == 1  # A,B,C completed
-    # Snapshot with the truncation suppressed = crash in the window.
-    monkeypatch.setattr(sup._disk_journal, "truncate", lambda: None)
+    # Snapshot with the rotation suppressed = crash in the window.
+    monkeypatch.setattr(sup, "_rotate_journal", lambda: None)
     sup.checkpoint()
     assert len(list(Journal(jr).replay())) == 3  # frames survived the crash
     for b in batches_for(values[3:], t0=1003, off0=3):
@@ -157,3 +161,88 @@ def test_torn_tail_repair_then_second_resume(tmp_path):
     ref_state, ref_out = reference_state(values)
     assert_same_state(res2.processor.state, ref_state)
     assert len(ref_out) == len(emitted) == 1
+
+
+def _corrupt_file(path):
+    """Flip bytes deep inside the snapshot's array payload (bit rot)."""
+    with open(path, "r+b") as f:
+        f.seek(-64, 2)
+        f.write(b"\xff" * 16)
+
+
+def test_corrupt_snapshot_detected_by_digest(tmp_path):
+    from kafkastreams_cep_tpu.runtime import (
+        CEPProcessor, CheckpointCorrupt, load_checkpoint, save_checkpoint,
+    )
+    import pytest
+
+    proc = CEPProcessor(sc.strict3(), 1, sc.default_config(), gc_interval=0)
+    proc.process([Record("k", sc.A, 1000, offset=0)])
+    path = str(tmp_path / "d.ckpt")
+    save_checkpoint(proc, path)
+    assert load_checkpoint(path)["header"]["arrays_sha256"]
+    _corrupt_file(path)
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint(path)
+
+
+def test_corrupt_first_snapshot_falls_back_to_fresh_plus_journal_chain(
+    tmp_path,
+):
+    """Only one checkpoint ever taken, and it rots: resume must rebuild
+    from scratch off the journal chain (the rotation retired the
+    pre-snapshot frames into ``.prev``, so the chain covers seq 1..n)."""
+    values = [sc.A, sc.B, sc.C, sc.A, sc.B]
+    ck, jr = str(tmp_path / "c1.ckpt"), str(tmp_path / "c1.jrnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, checkpoint_every=3,
+        gc_interval=0,
+    )
+    emitted = []
+    for b in batches_for(values):
+        emitted += sup.process(b)
+    assert sup.checkpoints == 1
+    del sup
+    _corrupt_file(ck)
+
+    res = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, gc_interval=0,
+    )
+    assert res._seq == 5  # full history: .prev frames 1-3 + live 4-5
+    ref_state, ref_out = reference_state(values)
+    assert_same_state(res.processor.state, ref_state)
+    assert len(ref_out) == len(emitted) == 1
+
+
+def test_corrupt_snapshot_falls_back_to_previous_good(tmp_path):
+    """Two checkpoints, the newer one rots: resume restores the
+    previous-good ``.prev`` snapshot and the journal chain replays the
+    gap between the two, then the live tail."""
+    values = [sc.A, sc.B, sc.C, sc.A, sc.B, sc.C, sc.A]
+    ck, jr = str(tmp_path / "c2.ckpt"), str(tmp_path / "c2.jrnl")
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, checkpoint_every=3,
+        gc_interval=0,
+    )
+    emitted = []
+    for b in batches_for(values):
+        emitted += sup.process(b)
+    assert sup.checkpoints == 2
+    del sup
+    _corrupt_file(ck)
+
+    res = Supervisor.resume(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, gc_interval=0,
+    )
+    assert res._seq == 7
+    ref_state, ref_out = reference_state(values)
+    assert_same_state(res.processor.state, ref_state)
+    # Post-resume traffic matches exactly once.
+    more = res.process([Record("k", sc.B, 9000, offset=7)])
+    more += res.process([Record("k", sc.C, 9001, offset=8)])
+    assert len(more) == 1
+    assert len(emitted) == len(ref_out) == 2

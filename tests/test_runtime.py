@@ -304,6 +304,12 @@ def test_checkpoint_refuses_array_dtype_mismatch(tmp_path):
     buf = io.BytesIO()
     np.savez(buf, **arrays)
     blob["arrays"] = buf.getvalue()
+    # Re-sign the forged payload: the integrity digest (ISSUE 5) would
+    # otherwise catch the tamper first — this test is about the dtype
+    # rule an *intact* but dtype-flipped checkpoint must still hit.
+    import hashlib
+
+    blob["header"]["arrays_sha256"] = hashlib.sha256(blob["arrays"]).hexdigest()
     with open(path, "wb") as f:
         pickle.dump(blob, f)
     with pytest.raises(ValueError, match="dtype"):

@@ -326,3 +326,79 @@ def test_supervisor_metrics_snapshot(tmp_path):
     assert snap["checkpoints"] == 1
     assert snap["recoveries"] == 0
     assert snap["records_in"] == 1
+
+
+# -- retry backoff (ISSUE 5 satellite) ---------------------------------------
+
+
+def _failing_supervisor(tmp_path, monkeypatch, fail_times, **kw):
+    """A supervisor whose processor faults on the first ``fail_times``
+    dispatches of value B, with sleeps captured instead of slept."""
+    sup = Supervisor(
+        sc.strict3(), 1, sc.default_config(),
+        checkpoint_path=str(tmp_path / "b.ckpt"), max_retries=4, **kw,
+    )
+    slept = []
+    sup._sleep = slept.append
+    state = {"left": fail_times}
+    orig = CEPProcessor.process
+
+    def flaky(self, records):
+        if state["left"] > 0 and any(r.value == sc.B for r in records):
+            state["left"] -= 1
+            raise RuntimeError("transient device loss")
+        return orig(self, records)
+
+    monkeypatch.setattr(CEPProcessor, "process", flaky)
+    return sup, slept
+
+
+def test_retry_backoff_is_exponential_capped_and_counted(
+    tmp_path, monkeypatch
+):
+    sup, slept = _failing_supervisor(
+        tmp_path, monkeypatch, fail_times=3,
+        retry_backoff_ms=100.0, retry_backoff_cap_ms=250.0,
+    )
+    sup.process([Record("k", sc.A, 1)])
+    out = sup.process([Record("k", sc.B, 2)])
+    assert sup.recoveries == 3
+    assert len(slept) == 3
+    # Exponential-with-jitter: each delay in [0.5, 1.0) x min(cap, base*2^n).
+    for n, s in enumerate(slept):
+        hi = min(250.0, 100.0 * 2 ** n) / 1000.0
+        assert hi * 0.5 <= s < hi, (n, s)
+    assert slept[2] < 0.250  # the cap bit (800 ms uncapped)
+    assert sup.retry_backoff_ms_total == pytest.approx(
+        sum(slept) * 1000.0, rel=1e-6
+    )
+    assert sup.metrics_snapshot(per_lane=False)[
+        "retry_backoff_ms_total"
+    ] == pytest.approx(sum(slept) * 1000.0, rel=1e-6)
+    # The batch eventually succeeded and the C completes the match.
+    out += sup.process([Record("k", sc.C, 3)])
+    assert len(out) == 1
+
+
+def test_retry_backoff_jitter_is_deterministic(tmp_path, monkeypatch):
+    waits = []
+    for _ in range(2):
+        sup, slept = _failing_supervisor(
+            tmp_path, monkeypatch, fail_times=2, retry_backoff_ms=40.0,
+        )
+        sup.process([Record("k", sc.A, 1)])
+        sup.process([Record("k", sc.B, 2)])
+        waits.append(tuple(slept))
+        monkeypatch.undo()
+    assert waits[0] == waits[1]  # (seq, attempt)-seeded jitter
+
+
+def test_retry_backoff_zero_disables(tmp_path, monkeypatch):
+    sup, slept = _failing_supervisor(
+        tmp_path, monkeypatch, fail_times=1, retry_backoff_ms=0.0,
+    )
+    sup.process([Record("k", sc.A, 1)])
+    sup.process([Record("k", sc.B, 2)])
+    assert sup.recoveries == 1
+    assert slept == []
+    assert sup.retry_backoff_ms_total == 0.0
