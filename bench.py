@@ -476,6 +476,52 @@ def bench_engine(K, T, reps):
     else:
         log(f"engine[hot]: skipped (CEP_BENCH_HOT_ENTRIES={hot_n})")
 
+    # Per-stage attribution A/B (ISSUE 6): the same trace and shapes with
+    # stage_attribution=True — reports the measured overhead (acceptance:
+    # <= 3% on this headline) and the per-stage selectivity/cost table
+    # the compiler-tiering work reads.  CEP_BENCH_ATTR=0 skips.
+    attr_metrics = None
+    if os.environ.get("CEP_BENCH_ATTR", "1") == "1":
+        try:
+            import dataclasses as _dc
+
+            acfg = _dc.replace(cfg, stage_attribution=True)
+            ab = BatchMatcher(stock_demo.stock_pattern(), K, acfg)
+            as0 = ab.init_state()
+            astate, aout = ab.scan(as0, events)
+            jax.block_until_ready(aout.count)
+            abest = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                astate, aout = ab.scan(as0, events)
+                jax.block_until_ready(aout.count)
+                abest = min(abest, time.perf_counter() - t0)
+            attr_evps = K * T / abest
+            overhead = (abest - best) / best * 100.0
+            per_stage = ab.stage_counters(astate)
+            attr_metrics = {
+                "evps": round(attr_evps, 1),
+                "overhead_pct": round(overhead, 2),
+                "within_3pct": overhead <= 3.0,
+                "counters_match_baseline": ab.counters(astate) == counters,
+                "per_stage": per_stage,
+            }
+            log(
+                f"engine[attribution]: {attr_evps / 1e6:.2f}M ev/s "
+                f"({overhead:+.2f}% vs baseline, <=3% bound "
+                f"{'OK' if overhead <= 3.0 else 'EXCEEDED'}); per-stage "
+                f"selectivity "
+                + ", ".join(
+                    f"{s}={row['selectivity']}"
+                    for s, row in per_stage.items()
+                )
+            )
+            del ab, as0, astate, aout
+        except Exception as e:  # never break the headline
+            log(f"attribution bench failed: {type(e).__name__}: {e}")
+    else:
+        log("engine[attribution]: skipped (CEP_BENCH_ATTR=0)")
+
     # Lazy extraction A/B (ISSUE 4): the same trace eager vs lazy at the
     # same shapes, drained at a processor-like chunk cadence; reports the
     # per-step hop reduction (the device critical-path win), hot-hit-rate
@@ -499,7 +545,7 @@ def bench_engine(K, T, reps):
         except Exception as e:
             log(f"frontier sweep failed: {type(e).__name__}: {e}")
     return (K * T / best, spread, counters, recall, precision, hot_metrics,
-            lazy_metrics)
+            lazy_metrics, attr_metrics)
 
 
 def _chunked_scan(batch, events, chunk, lazy):
@@ -1319,7 +1365,7 @@ def main():
     parity_gate()
     bench_stencil(int(os.environ.get("CEP_BENCH_STENCIL_N", "1048576")), reps)
     (engine_evps, engine_spread, engine_counters, recall, precision,
-     hot_metrics, lazy_metrics) = bench_engine(K, T, reps)
+     hot_metrics, lazy_metrics, attr_metrics) = bench_engine(K, T, reps)
     if os.environ.get("CEP_BENCH_LOSSFREE", "1") != "0":
         lf_evps, lf_zero, lf_parity = bench_lossfree(
             int(os.environ.get("CEP_BENCH_LOSSFREE_K", "1024")),
@@ -1467,6 +1513,11 @@ def main():
                 # Lazy-extraction A/B on the same trace/shapes (ISSUE 4;
                 # None when CEP_BENCH_LAZY=0 or the run failed).
                 "lazy": lazy_metrics,
+                # Per-stage attribution A/B (ISSUE 6): measured overhead
+                # of stage_attribution on this headline + the per-stage
+                # selectivity/cost table (None when CEP_BENCH_ATTR=0 or
+                # the run failed).
+                "attribution": attr_metrics,
                 "lossfree_evps": round(lf_evps, 1),
                 "lossfree_counters_zero": bool(lf_zero),
                 "lossfree_oracle_parity": bool(lf_parity),

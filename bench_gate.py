@@ -1,0 +1,178 @@
+"""Bench regression gate — compare a new bench JSON against the
+BENCH_r0x trajectory with noise tolerance.
+
+The BENCH_r0x files record each round's ``bench.py`` headline (wrapped as
+``{"n": ..., "parsed": {...}}`` by the driver; a bare bench JSON with a
+``"value"`` key is accepted too).  Nothing watched that trajectory for
+regressions — a PR that halved throughput would land silently.  This gate
+fails (exit 1) when the new run is *statistically meaningfully* worse
+than the trajectory's best on any guarded metric:
+
+* **Throughput metrics** (higher is better): ``value`` (the headline
+  events/s) and ``lossfree_evps``.  The threshold is
+  ``best_baseline * (1 - tol)`` where ``tol = max(--rel-tol,
+  (baseline_spread + new_spread) / 100)`` — the reported rep-to-rep
+  spreads are the run's own noise estimate, so a noisy environment
+  widens its own tolerance instead of flapping the gate.
+* **Loss metrics** (must not degrade): ``lossfree_counters_zero`` and
+  ``lossfree_oracle_parity`` may not go true→false; ``recall_sampled``
+  may not drop by more than the same relative tolerance.
+
+Missing metrics are skipped on either side (early rounds carry fewer
+keys), so the gate accepts the existing r01→r05 trajectory replayed
+against itself unchanged — pinned by the tier-1 smoke test
+(tests/test_bench_gate.py) together with a reject on an injected 2×
+slowdown fixture.
+
+Usage::
+
+    python bench_gate.py NEW.json BENCH_r01.json BENCH_r02.json ...
+    python bench_gate.py NEW.json --trajectory 'BENCH_r0*.json'
+
+One JSON verdict on stdout; exit 0 = pass, 1 = regression, 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Throughput metrics guarded for "not meaningfully lower".
+RATE_METRICS = ("value", "lossfree_evps")
+#: Boolean metrics guarded for "never true -> false".
+FLAG_METRICS = ("lossfree_counters_zero", "lossfree_oracle_parity")
+#: Ratio metrics guarded like rates (0..1, higher is better).
+RATIO_METRICS = ("recall_sampled",)
+
+
+def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The comparable metrics of one bench document, or None when the
+    document carries no parsed result (e.g. BENCH_r01's empty round)."""
+    parsed = doc.get("parsed", doc) if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict) or "value" not in parsed:
+        return None
+    out: Dict[str, Any] = {}
+    for k in RATE_METRICS + RATIO_METRICS:
+        v = parsed.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            out[k] = float(v)
+    for k in FLAG_METRICS:
+        v = parsed.get(k)
+        if isinstance(v, bool):
+            out[k] = v
+    sp = parsed.get("spread_pct")
+    out["spread_pct"] = (
+        float(sp) if isinstance(sp, (int, float)) else 0.0
+    )
+    return out
+
+
+def load_doc(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def gate(
+    new: Dict[str, Any],
+    baselines: List[Dict[str, Any]],
+    rel_tol: float = 0.10,
+) -> Tuple[bool, Dict[str, Any]]:
+    """Compare ``new`` (a bench doc) against ``baselines`` (bench docs,
+    trajectory order).  Returns ``(ok, report)``."""
+    new_m = extract_metrics(new)
+    checks: List[Dict[str, Any]] = []
+    ok = True
+    if new_m is None:
+        return False, {
+            "ok": False,
+            "error": "new bench document carries no parsed result",
+            "checks": checks,
+        }
+    base_ms = [m for m in (extract_metrics(b) for b in baselines) if m]
+    if not base_ms:
+        return True, {
+            "ok": True,
+            "note": "no baseline carries a parsed result; nothing to gate",
+            "checks": checks,
+        }
+    new_spread = new_m.get("spread_pct", 0.0)
+
+    for metric in RATE_METRICS + RATIO_METRICS:
+        cands = [m for m in base_ms if metric in m]
+        if not cands or metric not in new_m:
+            continue
+        best = max(cands, key=lambda m: m[metric])
+        tol = max(rel_tol, (best["spread_pct"] + new_spread) / 100.0)
+        floor = best[metric] * (1.0 - tol)
+        passed = new_m[metric] >= floor
+        ok &= passed
+        checks.append(
+            {
+                "metric": metric,
+                "new": new_m[metric],
+                "baseline_best": best[metric],
+                "tolerance": round(tol, 4),
+                "floor": round(floor, 1),
+                "ok": passed,
+            }
+        )
+    for metric in FLAG_METRICS:
+        if not any(m.get(metric) is True for m in base_ms):
+            continue
+        if metric not in new_m:
+            continue
+        passed = bool(new_m[metric])
+        ok &= passed
+        checks.append(
+            {
+                "metric": metric,
+                "new": new_m[metric],
+                "baseline_best": True,
+                "ok": passed,
+            }
+        )
+    return ok, {"ok": ok, "rel_tol": rel_tol, "checks": checks}
+
+
+def gate_paths(
+    new_path: str, baseline_paths: List[str], rel_tol: float = 0.10
+) -> Tuple[bool, Dict[str, Any]]:
+    okflag, report = gate(
+        load_doc(new_path),
+        [load_doc(p) for p in sorted(baseline_paths)],
+        rel_tol=rel_tol,
+    )
+    report["new"] = new_path
+    report["baselines"] = sorted(baseline_paths)
+    return okflag, report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_gate.py", description=__doc__.split("\n\n")[0]
+    )
+    p.add_argument("new", help="new bench JSON to gate")
+    p.add_argument("baselines", nargs="*", help="baseline bench JSONs")
+    p.add_argument(
+        "--trajectory",
+        help="glob of baseline files (e.g. 'BENCH_r0*.json')",
+    )
+    p.add_argument("--rel-tol", type=float, default=0.10)
+    args = p.parse_args(argv)
+    paths = list(args.baselines)
+    if args.trajectory:
+        paths += glob.glob(args.trajectory)
+    paths = [p_ for p_ in paths if p_ != args.new]
+    if not paths:
+        print("bench_gate: no baseline files given", file=sys.stderr)
+        return 2
+    okflag, report = gate_paths(args.new, paths, rel_tol=args.rel_tol)
+    print(json.dumps(report, indent=2))
+    return 0 if okflag else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,7 @@ opts into functional pruning using the evaluation stage's window.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -182,6 +182,18 @@ class EngineConfig:
     # loss-free, like every other capacity knob — sizing.suggest derives it
     # from the probe's per-chunk match maxima).
     handle_ring: int = 16
+    # Continuous profiling (PROFILE/ISSUE 6): per-stage selectivity and
+    # cost attribution.  When True the engine carries per-stage tallies —
+    # frames evaluated / accepted (TAKE|BEGIN fired) / ignored / rejected
+    # per stage (``EngineState.stage_counts``, the lazy-chain stage-
+    # ordering signal of arxiv 1612.05110) plus per-stage walk-hop costs
+    # (``SlabState.stage_hops``, keyed by the walker's current stage) —
+    # threaded identically through the jnp path and both Pallas kernels,
+    # so the three paths agree bit-exactly.  Off (the default) every
+    # attribution array has zero size and every tally is skipped at trace
+    # time: zero new device work.  Not a capacity knob; migration must
+    # not flip it (runtime/migrate.py _SEMANTIC_FLAGS).
+    stage_attribution: bool = False
 
 
 class EventBatch(NamedTuple):
@@ -227,6 +239,10 @@ class EngineState(NamedTuple):
     hr_count: jnp.ndarray  # scalar int32 — pending handles
     step_seq: jnp.ndarray  # scalar int32 — monotone per-lane step counter
     handle_overflows: jnp.ndarray  # scalar int32 — ring-full match drops
+    # --- per-stage selectivity tallies (EngineConfig.stage_attribution;
+    #     shape [4, 0] when off — inert).  Row order is STAGE_TALLY_NAMES:
+    #     frames evaluated / accepted / ignored / rejected per stage.
+    stage_counts: jnp.ndarray  # [4, S] int32
 
 
 class StepOutput(NamedTuple):
@@ -327,6 +343,20 @@ WALK_COUNTER_NAMES = (
     "drain_hops",
 )
 
+# Per-stage selectivity tallies (EngineConfig.stage_attribution), in the
+# row order of ``EngineState.stage_counts``.  Like the walk counters these
+# are NOT loss indicators; they exist so the compiler-tiering and
+# lazy-chain stage-ordering work (ROADMAP) can read per-stage selectivity
+# (accepts / evals) and cost without hand-run scripts.  The per-stage
+# walk-hop cost rides ``SlabState.stage_hops`` and reports beside these
+# as ``stage_walk_hops``.
+STAGE_TALLY_NAMES = (
+    "stage_evals",
+    "stage_accepts",
+    "stage_ignores",
+    "stage_rejects",
+)
+
 
 def counter_values(state: "EngineState") -> Tuple[jnp.ndarray, ...]:
     """The counters of ``state`` in ``COUNTER_NAMES`` order."""
@@ -380,6 +410,49 @@ def per_lane_counter_arrays(state: "EngineState") -> Dict[str, Any]:
     }
 
 
+def stage_counter_arrays(state: "EngineState") -> Dict[str, Any]:
+    """Per-stage attribution arrays as host int64 ndarrays: the four
+    selectivity tallies (``STAGE_TALLY_NAMES``, each ``[..., S]``) plus
+    ``stage_walk_hops`` from the slab.  Leading batch axes (lanes) are
+    preserved so callers can attribute per lane *and* per stage; empty
+    dict when attribution is off (zero-size arrays).  One ``device_get``
+    for all of them."""
+    if int(state.stage_counts.shape[-1]) == 0:
+        return {}
+    sc, sh = jax.device_get((state.stage_counts, state.slab.stage_hops))
+    sc = np.asarray(sc).astype(np.int64)
+    out = {
+        n: sc[..., i, :] for i, n in enumerate(STAGE_TALLY_NAMES)
+    }
+    out["stage_walk_hops"] = np.asarray(sh).astype(np.int64)
+    return out
+
+
+def stage_report(
+    arrays: Dict[str, Any], names: Sequence[str]
+) -> Dict[str, Dict[str, int]]:
+    """``stage_counter_arrays`` output -> ``{stage_name: {metric: total}}``
+    with leading (lane) axes summed away and a derived ``selectivity``
+    (accepts / evals) per stage — the roll-up ``metrics_snapshot``
+    publishes under ``per_stage``."""
+    if not arrays:
+        return {}
+    S = next(iter(arrays.values())).shape[-1]
+    out: Dict[str, Dict[str, int]] = {}
+    for s in range(S):
+        name = names[s] if s < len(names) else f"stage{s}"
+        row = {
+            metric: int(np.asarray(arr).reshape(-1, S)[:, s].sum())
+            for metric, arr in arrays.items()
+        }
+        ev = row.get("stage_evals", 0)
+        row["selectivity"] = (
+            round(row.get("stage_accepts", 0) / ev, 6) if ev else 0.0
+        )
+        out[name] = row
+    return out
+
+
 class StepPhases(NamedTuple):
     """The step's per-lane phase functions, exposed so batched callers can
     run the walk pass over the full lane batch (the fused Pallas kernel
@@ -427,6 +500,8 @@ class _ChainRecord(NamedTuple):
     has_succ: jnp.ndarray
     dead: jnp.ndarray
     ovf: jnp.ndarray  # int32 — Dewey overflows in this chain
+    stage_tally: jnp.ndarray  # [4, S] int32 — per-stage selectivity tallies
+    #   in STAGE_TALLY_NAMES row order ([4, 0] when attribution is off)
 
 
 def _build_step(tables, cfg: EngineConfig):
@@ -467,6 +542,9 @@ def _build_step(tables, cfg: EngineConfig):
     H = tables.max_hops
     NS = max(max(t.num_states for t in tlist), 1)
     S_CAND = 1 + H + 1  # survivor, branch per hop, re-seed
+    # Per-stage attribution width: the pattern's stage count when enabled,
+    # 0 (zero-size arrays, zero device work) when not.
+    S_AT = tables.num_stages if cfg.stage_attribution else 0
 
     # Per-query predicate-id offsets into the merged dispatch list.
     pred_base = np.cumsum([0] + [len(t.predicates) for t in tlist])[:-1]
@@ -637,6 +715,7 @@ def _build_step(tables, cfg: EngineConfig):
         br_en, br_prev, br_ver, br_vlen = [], [], [], []
         br_run_ver, br_run_vlen, br_id, br_eval, br_event, br_start = [], [], [], [], [], []
         consumed_h, frame_pos = [], []
+        tally = jnp.zeros((4, S_AT), i32)
 
         for _h in range(H):
             cs = jnp.maximum(cur, 0)
@@ -650,6 +729,17 @@ def _build_step(tables, cfg: EngineConfig):
             branch_m = (pr_m & take_m) | (ig_m & take_m) | (ig_m & begin_m) | (ig_m & pr_m)
             branch_m = branch_m & (prev >= 0)  # unreachable for seeds; guard
             consumed = take_m | begin_m
+            if S_AT:
+                # Per-stage selectivity: every frame that ran predicate
+                # dispatch at stage ``cs`` tallies one eval, plus one
+                # accept (consumed), ignore, or reject (nothing fired —
+                # the run dead-ends here) as applicable.
+                rejected = active & ~consumed & ~ig_m & ~pr_m
+                oh_s = jnp.arange(S_AT, dtype=i32) == cs
+                tally = tally + (
+                    oh_s[None, :]
+                    & jnp.stack([active, consumed, ig_m, rejected])[:, None]
+                ).astype(i32)
 
             # Survivor: at most one across the chain — a frame either
             # recurses on PROCEED or emits its single local successor.
@@ -747,7 +837,7 @@ def _build_step(tables, cfg: EngineConfig):
             stk(br_en), stk(br_prev), stk(br_ver), stk(br_vlen),
             stk(br_run_ver), stk(br_run_vlen), stk(br_id), stk(br_eval),
             stk(br_event), stk(br_start),
-            stk(br_agg), final_agg, has_succ, dead, ovf,
+            stk(br_agg), final_agg, has_succ, dead, ovf, tally,
         )
 
     RH = R * H
@@ -1099,6 +1189,8 @@ def _build_step(tables, cfg: EngineConfig):
             run_drops=state.run_drops + dropped,
             ver_overflows=state.ver_overflows + jnp.sum(rec.ovf),
             step_seq=state.step_seq,
+            stage_counts=state.stage_counts
+            + jnp.sum(rec.stage_tally, axis=0),
             **hr,
         )
 
@@ -1132,7 +1224,9 @@ def _build_step(tables, cfg: EngineConfig):
             start_ts=jnp.full((R,), -1, i32),
             branching=jnp.zeros((R,), bool),
             agg=jnp.broadcast_to(inits[q], (R, NS)),
-            slab=slab_mod.make(cfg.slab_entries, cfg.slab_preds, D),
+            slab=slab_mod.make(
+                cfg.slab_entries, cfg.slab_preds, D, num_stages=S_AT
+            ),
             run_drops=jnp.zeros((), i32),
             ver_overflows=jnp.zeros((), i32),
             hr_stage=jnp.full((HB,), -1, i32),
@@ -1145,6 +1239,7 @@ def _build_step(tables, cfg: EngineConfig):
             hr_count=jnp.zeros((), i32),
             step_seq=jnp.zeros((), i32),
             handle_overflows=jnp.zeros((), i32),
+            stage_counts=jnp.zeros((4, S_AT), i32),
         )
 
     phases = StepPhases(
@@ -1290,6 +1385,13 @@ class TPUMatcher:
             n: int(v)
             for n, v in zip(WALK_COUNTER_NAMES, walk_counter_values(state))
         }
+
+    def stage_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
+        """Per-stage selectivity/cost attribution
+        (``EngineConfig.stage_attribution``): ``{stage_name: {tally:
+        total, ..., selectivity}}`` summed over any leading lane axes;
+        empty dict when attribution is off."""
+        return stage_report(stage_counter_arrays(state), self.names)
 
 
 class MatcherSession:

@@ -112,7 +112,13 @@ def build_scan(tables, config: EngineConfig):
     # kernel (engine/matcher.py build_drain) at scan cadence.
     LAZY = cfg.lazy_extraction
     HB = cfg.handle_ring
-    N_OUT = 43  # kernel output refs (run state + slab + counters + ring + emits)
+    # Per-stage attribution width (EngineConfig.stage_attribution): when
+    # 0 the two attribution arrays are absent from the kernel I/O and all
+    # tally code vanishes at trace time — zero new device work.
+    SA = tables.num_stages if cfg.stage_attribution else 0
+    # kernel output refs (run state + slab + counters + ring + emits
+    # [+ the two stage-attribution arrays when SA > 0])
+    N_OUT = 43 + (2 if SA else 0)
     H = tables.max_hops
     NS = max(tables.num_states, 1)
     S_CAND = 1 + H + 1
@@ -175,21 +181,34 @@ def build_scan(tables, config: EngineConfig):
         # lazy-extraction handle ring + step counter
         hr_stage, hr_off, hr_vlen_i, hr_ts, hr_seq, hr_row, hr_ver,
         hr_count, seq0, hovf,
-        # per-t event slices
-        ev_key, ev_ts, ev_off, ev_valid, *rest,
+        # tail: [stc_in, shp_in when SA] then per-t event slices, outputs,
+        # scratch — unpacked by index so SA == 0 adds nothing.
+        *rest,
     ):
+        ri = 0
+        if SA:
+            stc_in, shp_in = rest[0], rest[1]
+            ri = 2
+        ev_key, ev_ts, ev_off, ev_valid = rest[ri:ri + 4]
+        ri += 4
         n_leaves = len(value_dtypes)
-        ev_leaves = rest[:n_leaves]
+        ev_leaves = rest[ri:ri + n_leaves]
+        ri += n_leaves
+        outs_flat = rest[ri:ri + N_OUT]
         (o_alive, o_id, o_eval, o_vlen, o_event, o_start, o_branch, o_agg,
          o_ver, o_sstage, o_soff, o_srefs, o_snpreds, o_spstage, o_spoff,
          o_spvlen, o_spver, o_rd, o_vo, o_fd, o_pd, o_ms, o_tr,
          o_hh, o_hm, o_ow, o_dm, o_wh, o_eh, o_dh,
          o_hrstage, o_hroff, o_hrvlen, o_hrts, o_hrseq, o_hrrow, o_hrver,
-         o_hrcount, o_seq, o_hovf,
-         o_ostage, o_ooff, o_ocount) = rest[n_leaves:n_leaves + N_OUT]
+         o_hrcount, o_seq, o_hovf) = outs_flat[:40]
+        oi = 40
+        if SA:
+            o_stc, o_shp = outs_flat[40], outs_flat[41]
+            oi = 42
+        o_ostage, o_ooff, o_ocount = outs_flat[oi:oi + 3]
         if EO:
             (sc_found, sc_refs, sc_np, sc_ps, sc_po, sc_pl, sc_pv) = rest[
-                n_leaves + N_OUT:
+                ri + N_OUT:
             ]
 
         t = pl.program_id(1)
@@ -235,6 +254,9 @@ def build_scan(tables, config: EngineConfig):
             o_hrver[:] = hr_ver[:]
             o_hrcount[:] = hr_count[:]
             o_hovf[:] = hovf[:]
+            if SA:
+                o_stc[:] = stc_in[:]
+                o_shp[:] = shp_in[:]
 
         # The per-lane step counter ticks every step (padding included) —
         # it is the emission t-index, not match state.  seq_now is this
@@ -325,6 +347,9 @@ def build_scan(tables, config: EngineConfig):
         br_en, br_prev, br_ver, br_vlen = [], [], [], []
         br_run_ver, br_id, br_eval, br_event, br_start = [], [], [], [], []
         consumed_h, frame_pos = [], []
+        if SA:
+            iota_sar = jax.lax.broadcasted_iota(i32, (SA, R, L), 0)
+            tly = [jnp.zeros((SA, L), i32) for _ in range(4)]
 
         for _h in range(H):
             cs = jnp.maximum(cur, 0)
@@ -339,6 +364,16 @@ def build_scan(tables, config: EngineConfig):
                 | (ig_m & pr_m)
             ) & (prev >= 0)
             consumed = take_m | begin_m
+            if SA:
+                # Per-stage selectivity tallies (matcher.chain_one):
+                # evaluated / accepted / ignored / rejected frames by
+                # stage, reduced over the run axis.
+                rejected = active & ~consumed & ~ig_m & ~pr_m
+                hit_s = iota_sar == cs[None]
+                for c, m in enumerate((active, consumed, ig_m, rejected)):
+                    tly[c] = tly[c] + jnp.sum(
+                        jnp.where(hit_s & m[None], 1, 0), axis=1
+                    )
 
             st = take_m & ~branch_m
             sb = begin_m
@@ -453,6 +488,8 @@ def build_scan(tables, config: EngineConfig):
         has_succ = surv_alive | any_br
         dead = st_alive & ~seed & ~has_succ & valid
         final_en = surv_alive & surv_final & valid
+        if SA:
+            o_stc[:] = o_stc[:] + jnp.stack(tly)
 
         # ---- phase 3: consuming puts, in queue order (one per lane per
         # batch — the sequential semantics; port of walk_kernel put phase
@@ -619,6 +656,8 @@ def build_scan(tables, config: EngineConfig):
         w_rank = jnp.where(w_en, _cumsum0(w_en_i) - 1, -1)
         max_n = jnp.max(jnp.sum(w_en_i, axis=0))
         iota_pw = jax.lax.broadcasted_iota(i32, (PW, L), 0)
+        if SA:
+            iota_sa2 = jax.lax.broadcasted_iota(i32, (SA, L), 0)
         # Emission blocks carry the t axis as a leading 1 (out_t_spec).
         iota_or3 = jax.lax.broadcasted_iota(i32, (1, R, W, L), 1)
         iota_w2 = jax.lax.broadcasted_iota(i32, (W, L), 0)
@@ -667,6 +706,12 @@ def build_scan(tables, config: EngineConfig):
                 o_eh[:] = o_eh[:] + jnp.where(
                     hactive & (wot_i != 0), 1, 0
                 )
+                if SA:
+                    # Per-stage hop attribution at the walker's current
+                    # stage (ops/slab.py _hop_counts; walk_kernel parity).
+                    o_shp[:] = o_shp[:] + jnp.where(
+                        (iota_sa2 == cs) & hactive, 1, 0
+                    )
                 # Hot-tier lookup first (ops/walk_kernel.py hop): the
                 # overflow rows are touched only when some lane of the
                 # block missed hot.
@@ -1125,6 +1170,14 @@ def build_scan(tables, config: EngineConfig):
             row(state.hr_count),
             row(state.step_seq),
             row(state.handle_overflows),
+        ]
+        if SA:
+            ins += [
+                # [K, 4, S] -> [4, S, K] and [K, S] -> [S, K].
+                jnp.transpose(state.stage_counts, (1, 2, 0)),
+                tin(state.slab.stage_hops),
+            ]
+        ins += [
             tev(jnp.asarray(events.key, jnp.int32)),
             tev(jnp.asarray(events.ts, jnp.int32)),
             tev(jnp.asarray(events.off, jnp.int32)),
@@ -1158,7 +1211,7 @@ def build_scan(tables, config: EngineConfig):
                 memory_space=pltpu.VMEM,
             )
 
-        n_state = 40
+        n_state = 40 + (2 if SA else 0)
         in_specs = (
             [state_spec(tuple(x.shape)) for x in ins[:n_state]]
             + [ev_spec(tuple(x.shape)) for x in ins[n_state:]]
@@ -1209,6 +1262,13 @@ def build_scan(tables, config: EngineConfig):
             jax.ShapeDtypeStruct((1, K), i32),  # hr_count
             jax.ShapeDtypeStruct((1, K), i32),  # step_seq
             jax.ShapeDtypeStruct((1, K), i32),  # handle_overflows
+        ]
+        if SA:
+            out_shapes += [
+                jax.ShapeDtypeStruct((4, SA, K), i32),  # stage_counts
+                jax.ShapeDtypeStruct((SA, K), i32),  # stage_hops
+            ]
+        out_shapes += [
             jax.ShapeDtypeStruct((T, R, W, K), i32),  # out stage
             jax.ShapeDtypeStruct((T, R, W, K), i32),  # out off
             jax.ShapeDtypeStruct((T, R, K), i32),  # out count
@@ -1250,8 +1310,14 @@ def build_scan(tables, config: EngineConfig):
          n_spvlen, n_spver, n_rd, n_vo, n_fd, n_pd, n_ms, n_tr,
          n_hh, n_hm, n_ow, n_dm, n_wh, n_eh, n_dh,
          n_hrstage, n_hroff, n_hrvlen, n_hrts, n_hrseq, n_hrrow, n_hrver,
-         n_hrcount, n_seq, n_hovf,
-         o_stage, o_off, o_count) = outs
+         n_hrcount, n_seq, n_hovf) = outs[:40]
+        if SA:
+            n_stc = jnp.transpose(outs[40], (2, 0, 1))  # [K, 4, S]
+            n_shp = jnp.moveaxis(outs[41], -1, 0)  # [K, S]
+        else:
+            n_stc = state.stage_counts
+            n_shp = state.slab.stage_hops
+        o_stage, o_off, o_count = outs[n_state:]
 
         unrow = lambda x: x[0]
         new_state = EngineState(
@@ -1285,6 +1351,7 @@ def build_scan(tables, config: EngineConfig):
                 walk_hops=unrow(n_wh),
                 extract_hops=unrow(n_eh),
                 drain_hops=unrow(n_dh),
+                stage_hops=n_shp,
             ),
             run_drops=unrow(n_rd),
             ver_overflows=unrow(n_vo),
@@ -1298,6 +1365,7 @@ def build_scan(tables, config: EngineConfig):
             hr_count=unrow(n_hrcount),
             step_seq=unrow(n_seq),
             handle_overflows=unrow(n_hovf),
+            stage_counts=n_stc,
         )
         out = StepOutput(
             stage=jnp.transpose(o_stage, (3, 0, 1, 2)),  # [K, T, R, W]

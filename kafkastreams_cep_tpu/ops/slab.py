@@ -89,9 +89,19 @@ class SlabState(NamedTuple):
     walk_hops: jnp.ndarray  # scalar int32 — branch/dead-removal walker hops
     extract_hops: jnp.ndarray  # scalar int32 — eager in-step extraction hops
     drain_hops: jnp.ndarray  # scalar int32 — deferred drain-pass hops (lazy)
+    # --- per-stage walk-cost attribution (EngineConfig.stage_attribution):
+    #     hop tallies keyed by the walker's CURRENT stage at each hop, the
+    #     per-stage half of the continuous-profiling layer.  Shape [S]
+    #     (S = the pattern's stage count) when attribution is on, [0] when
+    #     off — a zero-size array adds no device work and no kernel
+    #     plumbing (both Pallas kernels skip it at trace time).  Never a
+    #     loss indicator.
+    stage_hops: jnp.ndarray  # [S] int32 — walk hops by current stage
 
 
-def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
+def make(
+    num_entries: int, max_preds: int, depth: int, num_stages: int = 0
+) -> SlabState:
     E, MP, D = num_entries, max_preds, depth
     i32 = jnp.int32
     return SlabState(
@@ -115,6 +125,7 @@ def make(num_entries: int, max_preds: int, depth: int) -> SlabState:
         walk_hops=jnp.zeros((), dtype=i32),
         extract_hops=jnp.zeros((), dtype=i32),
         drain_hops=jnp.zeros((), dtype=i32),
+        stage_hops=jnp.zeros((num_stages,), dtype=i32),
     )
 
 
@@ -222,7 +233,9 @@ def _tier_counts(slab: SlabState, active, found_hot, found):
     )
 
 
-def _hop_counts(slab: SlabState, active, want_out=None, kind: str = "walk"):
+def _hop_counts(
+    slab: SlabState, active, want_out=None, kind: str = "walk", stage=None
+):
     """Classify one hop's active walkers into the walk-cost counters.
 
     ``want_out`` (when given) splits the pool: emitting walkers count to
@@ -230,6 +243,11 @@ def _hop_counts(slab: SlabState, active, want_out=None, kind: str = "walk"):
     non-emitting walkers to ``walk_hops``.  Without it, every active
     walker counts to ``kind``.  Static ``kind`` keeps the counter choice
     trace-time, mirroring the Pallas kernels' static routing.
+
+    ``stage`` (the walkers' current stage, scalar or ``[P]``) additionally
+    attributes every active hop to its ``stage_hops[stage]`` row when the
+    slab carries stage attribution (``stage_hops.shape[-1] > 0``); with
+    attribution off the tally is skipped at trace time.
     """
     i32 = jnp.int32
     if want_out is None:
@@ -247,6 +265,15 @@ def _hop_counts(slab: SlabState, active, want_out=None, kind: str = "walk"):
         upd["drain_hops"] = slab.drain_hops + n_emit
     else:  # pragma: no cover - trace-time misuse
         raise ValueError(f"unknown hop kind {kind!r}")
+    S = int(slab.stage_hops.shape[-1])
+    if S and stage is not None:
+        oh = (
+            jnp.asarray(stage, i32)[..., None]
+            == jnp.arange(S, dtype=i32)
+        ) & jnp.asarray(active)[..., None]
+        upd["stage_hops"] = slab.stage_hops + jnp.sum(
+            oh.astype(i32).reshape(-1, S), axis=0
+        )
     return slab._replace(**upd)
 
 
@@ -365,7 +392,7 @@ def branch(slab: SlabState, stage, off, ver, vlen, max_walk: int, enable=True, h
             slab = _tier_counts(
                 slab, active, found & (e < hot_entries), found
             )
-        slab = _hop_counts(slab, active)
+        slab = _hop_counts(slab, active, stage=stage)
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
         slab = slab._replace(
@@ -428,7 +455,7 @@ def peek(
             slab = _tier_counts(
                 slab, active, found & (e < hot_entries), found
             )
-        slab = _hop_counts(slab, active, kind=hop_kind)
+        slab = _hop_counts(slab, active, kind=hop_kind, stage=stage)
         slab = slab._replace(missing=slab.missing + jnp.where(active & ~found, 1, 0))
         active = active & found
         m1 = _oh(e, E) & active
@@ -626,7 +653,8 @@ def walks_batched(
                 slab, active, jnp.any(hit[:, :hot_entries], axis=1), found
             )
         slab = _hop_counts(
-            slab, active, want_out, kind="drain" if drain else "extract"
+            slab, active, want_out, kind="drain" if drain else "extract",
+            stage=stage,
         )
         slab = slab._replace(
             missing=slab.missing + jnp.sum((active & ~found).astype(i32))
@@ -1042,7 +1070,7 @@ def branch_batched(
             slab = _tier_counts(
                 slab, active, jnp.any(hit[:, :hot_entries], axis=1), found
             )
-        slab = _hop_counts(slab, active)
+        slab = _hop_counts(slab, active, stage=stage)
         slab = slab._replace(
             missing=slab.missing + jnp.sum((active & ~found).astype(i32))
         )

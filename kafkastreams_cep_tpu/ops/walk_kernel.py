@@ -209,16 +209,28 @@ def _kernel(
     fulld, predd, hh, hm, ow, dm, wh, eh, dh,
     p_first, p_cur, p_pstage, p_poff, p_vlen, p_ver, p_rank, p_nen, ev_off,
     en, wstage, woff, wvlen, wver, wrem, wout, rank, nen,
-    # outputs
-    o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
-    o_missing, o_trunc, o_fulld, o_predd, o_hh, o_hm, o_ow, o_dm,
-    o_wh, o_eh, o_dh,
-    o_ostage, o_ooff, o_count,
-    # scratch (tier_scratch is empty unless EH > 0)
-    st_stage, st_off, *tier_scratch,
+    # the tail holds, in order: [shp] (stage-hop input, SA > 0 only), the
+    # 22 outputs, [o_shp] (SA > 0 only), the two staging scratch buffers,
+    # and the tier scratch (EH > 0 only) — unpacked by index below so the
+    # attribution plumbing vanishes entirely when SA == 0.
+    *rest,
     W: int, out_base: int, out_rows: int, with_puts: bool, EH: int,
-    drain: bool,
+    SA: int, drain: bool,
 ):
+    i = 0
+    if SA:
+        shp = rest[i]
+        i += 1
+    (o_stage, o_off, o_refs, o_npreds, o_pstage, o_poff, o_pvlen, o_pver,
+     o_missing, o_trunc, o_fulld, o_predd, o_hh, o_hm, o_ow, o_dm,
+     o_wh, o_eh, o_dh,
+     o_ostage, o_ooff, o_count) = rest[i:i + 22]
+    i += 22
+    if SA:
+        o_shp = rest[i]
+        i += 1
+    st_stage, st_off = rest[i], rest[i + 1]
+    tier_scratch = rest[i + 2:]
     E, MP, L = pstage.shape
     # pver blocks arrive [D, E, MP, L]: the tiled trailing dims are then
     # (MP=8-aligned, L) instead of (D, L) with D padded up to the sublane
@@ -258,6 +270,9 @@ def _kernel(
     o_wh[:] = wh[:]
     o_eh[:] = eh[:]
     o_dh[:] = dh[:]
+    if SA:
+        o_shp[:] = shp[:]
+        iota_sa = jax.lax.broadcasted_iota(i32, (SA, L), 0)
     o_ostage[:] = jnp.full((OR, W, L), -1, i32)
     o_ooff[:] = jnp.full((OR, W, L), -1, i32)
     o_count[:] = jnp.zeros((OR, L), i32)
@@ -431,6 +446,12 @@ def _kernel(
                 o_dh[:] = o_dh[:] + emit_hop
             else:
                 o_eh[:] = o_eh[:] + emit_hop
+            if SA:
+                # Per-stage hop attribution (ops/slab.py _hop_counts):
+                # every active hop tallies at the walker's current stage.
+                o_shp[:] = o_shp[:] + jnp.where(
+                    (iota_sa == cs) & active, 1, 0
+                )
             # Hot-tier lookup first: [EHk, L] compares instead of [E, L].
             # The overflow rows are consulted only when some lane of the
             # block missed hot — the common all-hot hop never touches them
@@ -828,6 +849,11 @@ def walk_pass_kernel(
         tin(rank),
         row(nen),
     ]
+    # Per-stage hop attribution rides only when enabled — SA == 0 adds no
+    # input, no output, and no kernel ops (zero new device work).
+    SA = int(slab.stage_hops.shape[-1])
+    if SA:
+        ins.append(tin(slab.stage_hops))  # [S, K]
 
     L = LANE_BLOCK
     grid = (K // L,)
@@ -865,6 +891,8 @@ def walk_pass_kernel(
         jax.ShapeDtypeStruct((OR, W, K), i32),  # out_off
         jax.ShapeDtypeStruct((OR, K), i32),  # count
     ]
+    if SA:
+        out_shapes.append(jax.ShapeDtypeStruct((SA, K), i32))  # stage_hops
     out_specs = [bspec(tuple(s.shape[:-1]) + (L,)) for s in out_shapes]
 
     scratch_shapes = [
@@ -887,7 +915,7 @@ def walk_pass_kernel(
     outs = pl.pallas_call(
         functools.partial(
             _kernel, W=W, out_base=out_base, out_rows=out_rows,
-            with_puts=with_puts, EH=hot_entries, drain=drain,
+            with_puts=with_puts, EH=hot_entries, SA=SA, drain=drain,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -903,7 +931,8 @@ def walk_pass_kernel(
     (n_stage, n_off, n_refs, n_npreds, n_pstage, n_poff, n_pvlen, n_pver,
      n_missing, n_trunc, n_fulld, n_predd, n_hh, n_hm, n_ow, n_dm,
      n_wh, n_eh, n_dh,
-     o_stage, o_off, o_count) = outs
+     o_stage, o_off, o_count) = outs[:22]
+    new_stage_hops = tout(outs[22]) if SA else slab.stage_hops
     new_slab = slab._replace(
         stage=tout(n_stage),
         off=tout(n_off),
@@ -924,6 +953,7 @@ def walk_pass_kernel(
         walk_hops=unrow(n_wh),
         extract_hops=unrow(n_eh),
         drain_hops=unrow(n_dh),
+        stage_hops=new_stage_hops,
     )
     return (
         new_slab,

@@ -473,12 +473,27 @@ class BatchMatcher:
             for n, v in per_lane_counter_arrays(state).items()
         }
 
+    def stage_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
+        """Per-stage selectivity/cost attribution summed over all lanes
+        (``{stage_name: {tally: total, selectivity}}``); empty when
+        ``EngineConfig.stage_attribution`` is off."""
+        from kafkastreams_cep_tpu.engine.matcher import (
+            stage_counter_arrays,
+            stage_report,
+        )
+
+        return stage_report(stage_counter_arrays(state), self.names)
+
     def metrics_snapshot(self, state: EngineState) -> Dict[str, object]:
         """Engine-level telemetry of ``state`` in one dict: summed drop and
-        hot-tier counters plus the per-lane breakdown."""
+        hot-tier counters plus the per-lane breakdown (and the per-stage
+        attribution roll-up when enabled)."""
         out: Dict[str, object] = {}
         out.update(self.counters(state))
         out.update(self.hot_counters(state))
         out.update(self.walk_counters(state))
         out["per_lane"] = self.per_lane_counters(state)
+        per_stage = self.stage_counters(state)
+        if per_stage:
+            out["per_stage"] = per_stage
         return out

@@ -239,6 +239,48 @@ class ShardedMatcher:
             )
         )
 
+    @functools.cached_property
+    def _stage_stats(self):
+        """Mesh-global per-stage attribution: each shard reduces its lane
+        block to ``[5, S]`` (the four selectivity tallies + stage hops)
+        and one ``psum`` merges the shards — associative by construction
+        (integer addition), exactly like the scalar-counter psum."""
+        spec = P(self.axis)
+
+        def local(state: EngineState):
+            sc = jnp.sum(state.stage_counts, axis=0)  # [4, S]
+            sh = jnp.sum(state.slab.stage_hops, axis=0)[None, :]  # [1, S]
+            return jax.lax.psum(
+                jnp.concatenate([sc, sh], axis=0), self.axis
+            )
+
+        return jax.jit(
+            _shard_map(
+                local, mesh=self.mesh, in_specs=spec, out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    def stage_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
+        """Per-stage attribution totals psum-merged across every shard
+        (BatchMatcher interface); empty when attribution is off."""
+        from kafkastreams_cep_tpu.engine.matcher import (
+            STAGE_TALLY_NAMES,
+            stage_report,
+        )
+
+        if int(state.stage_counts.shape[-1]) == 0:
+            return {}
+        import numpy as np
+
+        merged = np.asarray(jax.device_get(self._stage_stats(state)))
+        arrays = {
+            n: merged[i].astype(np.int64)
+            for i, n in enumerate(STAGE_TALLY_NAMES)
+        }
+        arrays["stage_walk_hops"] = merged[4].astype(np.int64)
+        return stage_report(arrays, self.names)
+
     def per_lane_counters(self, state: EngineState) -> Dict[str, list]:
         """Per-lane drop + hot counters gathered from every shard:
         ``{name: [K ints]}`` with global lane indices (the lane axis is
@@ -258,6 +300,9 @@ class ShardedMatcher:
         psum IS the merge), the per-lane breakdown a host gather."""
         out: Dict[str, object] = dict(self.stats(state))
         out["per_lane"] = self.per_lane_counters(state)
+        per_stage = self.stage_counters(state)
+        if per_stage:
+            out["per_stage"] = per_stage
         return out
 
     def sweep(self, state: EngineState) -> EngineState:

@@ -177,6 +177,20 @@ class StackedBankMatcher:
             self._drain_jit = jax.jit(jax.vmap(build_drain(self.config)))
         return self._drain_jit(state)
 
+    def stage_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
+        """Per-stage attribution summed over the whole ``[Q*K]`` lane axis
+        (stage *positions* are shared by construction — stackable tables
+        have one stage shape — so the roll-up uses query 0's names);
+        empty when attribution is off."""
+        from kafkastreams_cep_tpu.engine.matcher import (
+            stage_counter_arrays,
+            stage_report,
+        )
+
+        return stage_report(
+            stage_counter_arrays(state), self.tables_list[0].names
+        )
+
     def per_query_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
         """Per-pattern attribution: drop + hot counters summed over each
         query's ``K``-lane block of the ``[Q*K]`` lane axis (lane layout is
@@ -202,6 +216,9 @@ class StackedBankMatcher:
         out.update(self.hot_counters(state))
         out.update(self.walk_counters(state))
         out["per_pattern"] = self.per_query_counters(state)
+        per_stage = self.stage_counters(state)
+        if per_stage:
+            out["per_stage"] = per_stage
         return out
 
 

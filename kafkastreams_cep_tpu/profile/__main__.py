@@ -1,0 +1,8 @@
+"""``python -m kafkastreams_cep_tpu.profile`` entry point."""
+
+import sys
+
+from kafkastreams_cep_tpu.profile import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ from kafkastreams_cep_tpu.runtime.checkpoint import (
     save_checkpoint,
     load_checkpoint,
 )
+from kafkastreams_cep_tpu.runtime.flight import FlightRecorder
 from kafkastreams_cep_tpu.runtime.ingest import (
     DeadLetter,
     IngestGuard,
@@ -48,6 +49,7 @@ __all__ = [
     "CEPProcessor",
     "CheckpointCorrupt",
     "DeadLetter",
+    "FlightRecorder",
     "HealthReport",
     "IngestGuard",
     "IngestPolicy",

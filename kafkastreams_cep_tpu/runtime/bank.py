@@ -88,6 +88,24 @@ class CEPBank:
             ]
         )
         snap = Metrics(registry=reg).snapshot(engine)
+        # Per-stage attribution merges member-wise by stage-name addition
+        # (associative, like every counter merge here); members without
+        # attribution contribute nothing.
+        per_stage: Dict[str, Dict[str, int]] = {}
+        for p in procs:
+            for stage, row in p.batch.stage_counters(p.state).items():
+                dst = per_stage.setdefault(stage, {})
+                for metric, v in row.items():
+                    if metric == "selectivity":
+                        continue
+                    dst[metric] = dst.get(metric, 0) + v
+        if per_stage:
+            for row in per_stage.values():
+                ev = row.get("stage_evals", 0)
+                row["selectivity"] = (
+                    round(row.get("stage_accepts", 0) / ev, 6) if ev else 0.0
+                )
+            snap["per_stage"] = per_stage
         snap["per_pattern"] = {
             name: {
                 **p.counters(),

@@ -276,6 +276,10 @@ def restore_processor(
         from kafkastreams_cep_tpu.runtime.ingest import IngestGuard
 
         proc._guard = IngestGuard.from_state(header["ingest"])
+        # Flight-recorder burst detection diffs against the cumulative
+        # dead-letter total; re-base it so a restore never reads the
+        # whole history as one burst.
+        proc._dlq_base = int(sum(proc._guard.reason_counts.values()))
     logger.info(
         "restored processor from %s: %d keys assigned, offsets %s",
         path, len(proc._lane_of), proc._next_offset.tolist(),

@@ -87,6 +87,10 @@ _SHAPE_DIMS = (
 _SEMANTIC_FLAGS = (
     "renorm_versions", "enforce_windows", "sequential_slab", "walker_budget",
     "lazy_extraction",
+    # Not semantic for the match stream, but it shapes the attribution
+    # arrays ([S] vs [0]) — a live embedding across the flip does not
+    # exist, so it rides the no-change list.
+    "stage_attribution",
 )
 
 
@@ -162,6 +166,9 @@ def widen_state(
         walk_hops=g(slab.walk_hops),
         extract_hops=g(slab.extract_hops),
         drain_hops=g(slab.drain_hops),
+        # Per-stage attribution: [S] is pattern-shaped, not capacity-
+        # shaped — copied verbatim like every other counter.
+        stage_hops=g(slab.stage_hops),
     )
     # Handle-ring axis (HB -> HB'): pending handles occupy a contiguous
     # prefix in completion order (appends at hr_count, drain clears to 0),
@@ -193,6 +200,7 @@ def widen_state(
         hr_count=g(state.hr_count),
         step_seq=g(state.step_seq),
         handle_overflows=g(state.handle_overflows),
+        stage_counts=g(state.stage_counts),
     )
 
 
@@ -259,6 +267,7 @@ def canonical_state(state: EngineState) -> EngineState:
         hr_count=g(state.hr_count),
         step_seq=g(state.step_seq),
         handle_overflows=g(state.handle_overflows),
+        stage_counts=g(state.stage_counts),
     )
 
 
@@ -314,6 +323,10 @@ def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
     new_proc._value_proto = proc._value_proto
     new_proc._step_base = proc._step_base  # pending-handle ordering base
     new_proc.metrics = proc.metrics  # continuity: one stream, one meter
+    # Flight recorder continuity: the ring (and its burst baseline) spans
+    # the migration like the metrics do.
+    new_proc.flight = proc.flight
+    new_proc._dlq_base = proc._dlq_base
     # Ingestion guard (runtime/ingest.py): pure host state — held records,
     # watermark, dead letters, and loss counters move with the migration
     # exactly like the event mirror (the engine never saw the held

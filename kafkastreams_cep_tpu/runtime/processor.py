@@ -146,6 +146,7 @@ class CEPProcessor:
         name: Optional[str] = None,
         drain_interval: int = 1,
         ingest: Optional[IngestPolicy] = None,
+        flight=None,
     ):
         # ``mesh``: a ``jax.sharding.Mesh`` shards the lane axis over the
         # devices (state-follows-partition, ``CEPProcessor.java:117-134`` —
@@ -246,6 +247,12 @@ class CEPProcessor:
         # and released to the engine in timestamp order with auto-assigned
         # engine offsets; source offsets drive replay dedup at admission.
         self._guard = IngestGuard(ingest) if ingest is not None else None
+        # Flight recorder (runtime/flight.py): a bounded ring of per-batch
+        # records (phase timings, counter deltas, occupancy) appended at
+        # the end of every batch and dumped as JSONL on crash/escalation/
+        # quarantine-burst — None costs one check per batch.
+        self.flight = flight
+        self._dlq_base = 0  # dead-letter total at last batch (burst detect)
 
     # -- key -> lane assignment (partition-assignment analog) ---------------
 
@@ -312,6 +319,9 @@ class CEPProcessor:
                 else:
                     packed = self._pack_records(records)
             if packed is None:
+                # Nothing released/kept this batch — still a flight tick
+                # (a quarantine burst can empty a batch entirely).
+                self._flight_tick()
                 return []
             events, rank_of, n_kept = packed
             sp["lanes"] = len(self._lane_of)
@@ -911,7 +921,23 @@ class CEPProcessor:
             with self._phase("gc"):
                 self._gc_events()
         self.metrics.matches_out += len(matches)
+        self._flight_tick()
         return matches
+
+    def _flight_tick(self) -> None:
+        """Record this batch in the flight ring (runtime/flight.py) and
+        trigger a quarantine-burst dump when the guard dead-lettered a
+        burst's worth of records in one batch.  One ``None`` check when
+        no recorder is attached."""
+        if self.flight is None:
+            return
+        corr = f"{self.name}-{self._batch_seq}"
+        self.flight.observe(self, corr=corr)
+        if self._guard is not None:
+            total = int(sum(self._guard.reason_counts.values()))
+            if total - self._dlq_base >= self.flight.quarantine_burst:
+                self.flight.dump("quarantine_burst", corr=corr)
+            self._dlq_base = total
 
     def flush(self) -> List[Tuple[Hashable, Sequence]]:
         """Drain the pipelined in-flight batch (no-op in serial mode or
@@ -1216,7 +1242,58 @@ class CEPProcessor:
                 "matches_out": self.metrics.matches_out,
             }
         }
+        per_stage = self.batch.stage_counters(self.state)
+        if per_stage:
+            # Per-stage selectivity & cost attribution
+            # (EngineConfig.stage_attribution) — the compiler-tiering /
+            # lazy-chain-ordering signal, labeled by stage name in the
+            # Prometheus rendering.
+            snap["per_stage"] = per_stage
         if per_lane:
             snap["per_lane"] = self.batch.per_lane_counters(self.state)
+            snap["per_key"] = self.per_key_cost(
+                per_lane_arrays=snap["per_lane"]
+            )
         snap["hbm"] = device_memory_stats()
         return snap
+
+    def per_key_cost(
+        self, top_k: int = 8, per_lane_arrays=None
+    ) -> Dict[str, Any]:
+        """Top-K heavy-hitter cost attribution by *key* (tentpole part 1,
+        the hot-key-rebalancing signal): each lane's total device walk
+        work (walk + extract + drain hops — the per-hop cost model's
+        observable) mapped back through the key→lane assignment, ranked,
+        with each hitter's share of the total.  Rendered as
+        ``cep_key_hops{key=...,lane=...}`` gauges by
+        ``utils/telemetry.render_prometheus``.  Works with attribution
+        off — the per-lane hop counters always exist.
+        """
+        arrays = (
+            per_lane_arrays
+            if per_lane_arrays is not None
+            else self.batch.per_lane_counters(self.state)
+        )
+        hops = (
+            np.asarray(arrays["walk_hops"], dtype=np.int64)
+            + np.asarray(arrays["extract_hops"], dtype=np.int64)
+            + np.asarray(arrays["drain_hops"], dtype=np.int64)
+        ).reshape(-1)
+        total = int(hops.sum())
+        order = np.argsort(hops, kind="stable")[::-1][: max(int(top_k), 1)]
+        top = []
+        for lane in order:
+            lane = int(lane)
+            if hops[lane] <= 0 or lane not in self._key_of:
+                continue
+            top.append(
+                {
+                    "key": str(self._key_of[lane]),
+                    "lane": lane,
+                    "hops": int(hops[lane]),
+                    "share": (
+                        round(float(hops[lane]) / total, 4) if total else 0.0
+                    ),
+                }
+            )
+        return {"total_hops": total, "top": top}

@@ -267,6 +267,14 @@ class Supervisor:
         self.telemetry = MetricsRegistry()
         for _n in ("checkpoint", "recover", "escalate"):
             self.telemetry.histogram(f"phase.{_n}")
+        # Flight recorder (runtime/flight.py): pass ``flight=`` like any
+        # processor kwarg; the supervisor owns the dump triggers — crash
+        # (retries exhausted), recovery, escalation — and re-attaches the
+        # recorder across restore/migrate (restored processors carry no
+        # telemetry wiring, same rule as the trace sink).
+        self.flight = self._proc_kwargs.get("flight")
+        if self.flight is not None:
+            self.processor.flight = self.flight
 
     @classmethod
     def resume(
@@ -329,6 +337,7 @@ class Supervisor:
         sup._seq = base_seq
         # An injected (restored) processor carries no telemetry wiring.
         sup.processor.trace = sup.trace
+        sup.processor.flight = sup.flight
         replayed = skipped = 0
         if sup._disk_journal is not None:
             # The chain: the retired ``.prev`` generation first (frames at
@@ -504,6 +513,11 @@ class Supervisor:
                 raise
             except Exception:
                 if attempt >= self.max_retries:
+                    # Crash: retries exhausted, the exception propagates
+                    # to the caller — ship the last-N-batches context
+                    # first so the post-mortem has it.
+                    if self.flight is not None:
+                        self.flight.dump("crash", corr=corr)
                     raise
                 logger.exception(
                     "processor failed on a %d-record batch; recovering",
@@ -618,6 +632,7 @@ class Supervisor:
             # Checkpoints carry no telemetry wiring: reattach the trace
             # sink so post-recovery batches keep emitting spans.
             self.processor.trace = self.trace
+            self.processor.flight = self.flight
         else:
             num_lanes = self.processor.num_lanes
             config = self.processor.batch.matcher.config
@@ -639,6 +654,11 @@ class Supervisor:
         # failure provoked it (None when driven outside process(), e.g.
         # a manual probe); the restore-and-replay cost lands in the
         # ``recover`` latency histogram either way.
+        if self.flight is not None:
+            # Dump BEFORE the rollback: the ring still holds the faulted
+            # batch's context (the restore rebuilds the processor, and
+            # replayed batches would overwrite the interesting tail).
+            self.flight.dump("recover", corr=corr)
         with maybe_span(
             self.trace, "recover", corr=corr, seq=self._seq,
         ) as sp, timed_histogram(self.telemetry, "phase.recover"):
@@ -741,6 +761,12 @@ class Supervisor:
                 self.trace, "escalate", corr=corr, round=_round,
                 tripped=dict(tripped), new_config=new_dims,
             ) as esp, timed_histogram(self.telemetry, "phase.escalate"):
+                if self.flight is not None:
+                    # Context of the batches that led to the trip, before
+                    # the rollback discards them.
+                    self.flight.note(escalation=self.escalations + 1,
+                                     tripped=dict(tripped))
+                    self.flight.dump("escalate", corr=corr)
                 if redo_prev:
                     prev_batch = self._journal.pop()
                 # Roll back to the pre-batch state; a pending pipelined
@@ -752,6 +778,7 @@ class Supervisor:
                     mesh=self._proc_kwargs.get("mesh"),
                 )
                 self.processor.trace = self.trace
+                self.processor.flight = self.flight
                 self.escalations += 1
                 logger.warning(
                     "capacity escalation #%d: %s after counters %s; "
@@ -871,6 +898,8 @@ class Supervisor:
         out["journal_failures"] = self.journal_failures
         out["escalations"] = self.escalations
         out["ingest_escalations"] = self.ingest_escalations
+        if self.flight is not None:
+            out["flight_dumps"] = self.flight.dumps
         out["retry_backoff_ms_total"] = round(self.retry_backoff_ms_total, 3)
         phases = dict(out.get("phases") or {})
         phases.update(

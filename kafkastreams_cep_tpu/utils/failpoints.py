@@ -215,6 +215,10 @@ SITES = (
     # and random_schedule seeds by position — see the docstring table).
     "ingest.admit",
     "ingest.release",
+    # Reporter cadence write: between serializing the metrics JSONL
+    # record and its single-write append — a crash here must leave the
+    # stream without any partial line (utils/telemetry.py Reporter.flush).
+    "report.write",
 )
 
 

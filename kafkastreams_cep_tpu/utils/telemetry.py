@@ -43,6 +43,7 @@ import contextlib
 import itertools
 import json
 import math
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -407,20 +408,76 @@ class JsonlTraceSink(TraceSink):
     file-like object.  The same stream carries spans, point events,
     Reporter metrics snapshots, and (with
     ``configure_logging(json_lines=True)``) lifecycle logs — one
-    machine-parseable firehose."""
+    machine-parseable firehose.
 
-    def __init__(self, target):
+    Path-owned sinks write each fully-serialized line through ONE
+    unbuffered binary write (open ``"ab", buffering=0``): a crash between
+    records leaves whole lines only, never a torn tail — the append-side
+    twin of the Reporter's atomic ``.prom`` replace (and failpoint-tested
+    through ``report.write``).
+
+    ``max_bytes`` / ``max_age_s`` bound a path-owned file: when either is
+    exceeded *at a line boundary*, the current file rolls to ``<path>.1``
+    (replacing any previous rollover — one retained generation) and a
+    fresh file starts.  Long-running supervisors previously grew the
+    JSONL without bound.
+    """
+
+    def __init__(
+        self,
+        target,
+        max_bytes: Optional[int] = None,
+        max_age_s: Optional[float] = None,
+    ):
         super().__init__()
+        self.max_bytes = max_bytes
+        self.max_age_s = max_age_s
+        self.rollovers = 0
         if isinstance(target, (str, bytes)):
-            self._fh = open(target, "a", encoding="utf-8")
+            self._path = target if isinstance(target, str) else target.decode()
             self._owns = True
+            self._open()
         else:
+            self._path = None
             self._fh = target
             self._owns = False
+            self._size = 0
+            self._birth = time.monotonic()
+
+    def _open(self) -> None:
+        self._fh = open(self._path, "ab", buffering=0)
+        self._size = self._fh.tell()
+        self._birth = time.monotonic()
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        if self._path is None or not self._size:
+            return
+        over_size = (
+            self.max_bytes is not None
+            and self._size + incoming > self.max_bytes
+        )
+        over_age = (
+            self.max_age_s is not None
+            and time.monotonic() - self._birth >= self.max_age_s
+        )
+        if not (over_size or over_age):
+            return
+        self._fh.close()
+        os.replace(self._path, self._path + ".1")
+        self.rollovers += 1
+        self._open()
 
     def write(self, event: Dict[str, Any]) -> None:
-        self._fh.write(json.dumps(event, default=str) + "\n")
-        self._fh.flush()
+        data = (json.dumps(event, default=str) + "\n").encode("utf-8")
+        if self._owns:
+            self._maybe_rotate(len(data))
+            self._fh.write(data)  # single unbuffered write: whole lines only
+        else:
+            self._fh.write(data.decode("utf-8"))
+            flush = getattr(self._fh, "flush", None)
+            if flush is not None:
+                flush()
+        self._size += len(data)
 
     def close(self) -> None:
         if self._owns:
@@ -554,6 +611,32 @@ def render_prometheus(
                     val[reason],
                     f'{{reason="{reason}"}}',
                 )
+        elif key == "per_stage" and isinstance(val, dict):
+            # Per-stage selectivity/cost attribution
+            # (EngineConfig.stage_attribution): one labeled series per
+            # stage per metric.
+            for stage in sorted(val):
+                sub = val[stage]
+                if not isinstance(sub, dict):
+                    continue
+                for cname in sorted(sub):
+                    v = sub[cname]
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        scalar(
+                            f"{prefix}_{_sanitize(cname)}",
+                            v,
+                            f'{{stage="{stage}"}}',
+                        )
+        elif key == "per_key" and isinstance(val, dict):
+            # Heavy-hitter cost attribution by key (processor
+            # ``per_key_cost``): the top-K lanes' walk work as gauges.
+            scalar(f"{prefix}_key_hops_total", val.get("total_hops"))
+            for ent in val.get("top", []):
+                scalar(
+                    f"{prefix}_key_hops",
+                    ent.get("hops"),
+                    f'{{key="{ent.get("key")}",lane="{ent.get("lane")}"}}',
+                )
         elif key == "per_pattern" and isinstance(val, dict):
             for pat in sorted(val):
                 sub = val[pat]
@@ -625,22 +708,33 @@ class Reporter:
         return self.flush() if due else None
 
     def flush(self) -> Dict[str, Any]:
-        """Snapshot and emit unconditionally."""
+        """Snapshot and emit unconditionally.
+
+        The JSONL record is serialized *before* anything is written and
+        lands through the sink's single-write append — a crash anywhere
+        in this method leaves either the complete record or nothing,
+        exactly like the ``.prom`` write's tmp-then-replace.  The
+        ``report.write`` failpoint sits in the serialized-but-unwritten
+        window (armed by the torn-line test in tests/test_telemetry.py).
+        """
+        from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
+
         snap = self.snapshot_fn()
         self.flushes += 1
         self._last_flush = time.perf_counter()
         if self.sink is not None:
-            self.sink.emit(
-                {
-                    "type": "metrics",
-                    "ts_ms": round(time.time() * 1000.0, 3),
-                    "tick": self.ticks,
-                    "snapshot": snap,
-                }
-            )
+            record = {
+                "type": "metrics",
+                "ts_ms": round(time.time() * 1000.0, 3),
+                "tick": self.ticks,
+                "snapshot": snap,
+            }
+            json.dumps(record, default=str)  # serialization failures fire here
+            # Fault site: the record exists only in memory; a crash here
+            # must leave the JSONL stream without any partial line.
+            _failpoint("report.write")
+            self.sink.emit(record)
         if self.prometheus_path is not None:
-            import os
-
             tmp = self.prometheus_path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
                 f.write(render_prometheus(snap, self.prefix))

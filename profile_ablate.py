@@ -1,178 +1,31 @@
-"""Ablation profile of the headline engine step — where do the ~40ms go?
+"""Thin wrapper — the profiler moved into the package CLI.
 
-Round 3's per-phase standalone bench (``profile_phases.py``) measured the
-slab kernels out of context (0.7 ms of a ~40 ms step) but could not see the
-phases *under real load inside the real scan* (data-dependent while-loop trip
-counts, fusion effects).  This tool measures the real thing by subtraction:
-it monkeypatches the batched slab kernels with no-ops and times the full
-headline scan at each cumulative stage:
-
-  A  chain+compaction only (all slab kernels no-op)
-  B  A + puts_batched
-  C  B + branch_batched
-  D  C + walks_batched            == the shipped engine
-
-Differences D-C, C-B, B-A attribute wall-clock to each phase.  A and D are
-exact end-point measurements (A = no slab at all, D = the shipped engine), so
-the slab total D-A is exact.  The B/C interior split is approximate: with
-walks ablated nothing is ever removed from the slab, so it saturates within a
-few steps and the puts/branch phases in B/C run against fuller-than-real
-state (puts against a full slab do comparable match/alloc work but drop the
-writes; the skew direction is unclear, and the affected deltas are <6% of
-the step).  Run on the real chip.
-
-Usage: python profile_ablate.py  [K] [T]
+``python profile_ablate.py [K] [T]`` ≡ ``python -m
+kafkastreams_cep_tpu.profile ablate --k K --t T`` (in-context ablation of
+the headline step: chain → +puts → +branch → +walks, one subprocess per
+variant; see the package docstring for the methodology caveats).
 """
-
 import os
 import sys
-import time
-
-import jax
-
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.expanduser("~"), ".cache", "cep_tpu_bench_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-
-import jax.numpy as jnp
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples")
-)
 
-import stock_demo
-from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
-from kafkastreams_cep_tpu.ops import slab as slab_mod
-from kafkastreams_cep_tpu.parallel import BatchMatcher
-
-REAL = {
-    "puts": slab_mod.puts_batched,
-    "branch": slab_mod.branch_batched,
-    "walks": slab_mod.walks_batched,
-}
+from kafkastreams_cep_tpu.profile import main
 
 
-def noop_puts(slab, ops, off):
-    return slab
-
-
-def noop_branch(slab, en, stage, off, ver, vlen, max_walk):
-    return slab
-
-
-def noop_walks(slab, en, stage, off, ver, vlen, is_remove, want_out,
-               max_walk, collect=True):
-    P = jnp.asarray(stage).shape[0]
-    i32 = jnp.int32
-    return (
-        slab,
-        jnp.full((P, max_walk), -1, i32),
-        jnp.full((P, max_walk), -1, i32),
-        jnp.zeros((P,), i32),
-    )
-
-
-def timed_scan(K, T, reps, label):
-    cfg = EngineConfig(
-        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
-        max_walk=12,
-    )
-    batch = BatchMatcher(stock_demo.stock_pattern(), K, cfg)
-    state0 = batch.init_state()
-    rng = np.random.default_rng(42)
-    prices = rng.integers(90, 131, size=(K, T)).astype(np.int32)
-    volumes = rng.integers(600, 1101, size=(K, T)).astype(np.int32)
-    events = EventBatch(
-        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
-        value={"price": jnp.asarray(prices), "volume": jnp.asarray(volumes)},
-        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :] * 2, (K, T)),
-        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
-        valid=jnp.ones((K, T), bool),
-    )
-    t0 = time.perf_counter()
-    state, out = batch.scan(state0, events)
-    jax.block_until_ready(out.count)
-    compile_s = time.perf_counter() - t0
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        state, out = batch.scan(state0, events)
-        jax.block_until_ready(out.count)
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    print(
-        f"{label:28s} compile {compile_s:6.1f}s  best {best * 1e3:8.1f} ms  "
-        f"({K * T / best / 1e3:8.0f}K ev/s)  reps {['%.0f' % (t * 1e3) for t in times]}",
-        file=sys.stderr, flush=True,
-    )
-    return best
-
-
-VARIANTS = {
-    "A": ("A chain+compact only", {"puts": noop_puts, "branch": noop_branch,
-                                   "walks": noop_walks}),
-    "B": ("B +puts", {"puts": "real", "branch": noop_branch,
-                      "walks": noop_walks}),
-    "C": ("C +puts+branch", {"puts": "real", "branch": "real",
-                             "walks": noop_walks}),
-    "D": ("D full (shipped)", {"puts": "real", "branch": "real",
-                               "walks": "real"}),
-}
-
-
-def run_one(which, K, T, reps):
-    label, patch = VARIANTS[which]
-    for k, v in patch.items():
-        setattr(slab_mod, k + "_batched", REAL[k] if v == "real" else v)
-    best = timed_scan(K, T, reps, label)
-    print(f"RESULT {which} {best!r}", flush=True)
-
-
-def main():
-    K = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-    T = int(sys.argv[2]) if len(sys.argv) > 2 else 256
-    reps = int(os.environ.get("CEP_PROFILE_REPS", "3"))
-
-    which = os.environ.get("CEP_ABLATE")
-    if which:
-        run_one(which, K, T, reps)
-        return
-
-    # Each variant runs in its own process: four matchers' states plus four
-    # compiled executables do not fit HBM together.
-    import subprocess
-
-    results = {}
-    for v in "ABCD":
-        env = dict(os.environ, CEP_ABLATE=v)
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), str(K), str(T)],
-            env=env, capture_output=True, text=True,
-        )
-        for line in out.stderr.splitlines():
-            if "WARNING" not in line:
-                print(line, file=sys.stderr)
-        for line in out.stdout.splitlines():
-            if line.startswith("RESULT"):
-                _, vv, t = line.split()
-                results[vv] = float(t)
-    if len(results) < 4:
-        print(f"incomplete: {results}")
-        return
-
-    a, b, c, d = results["A"], results["B"], results["C"], results["D"]
-    per_step = lambda t: t / T * 1e3
-    print(f"\n== ablation K={K} T={T} (ms/step of {per_step(d):.2f} total) ==")
-    print(f"chain+preds+compaction : {per_step(a):6.2f} ms/step ({a/d*100:5.1f}%)")
-    print(f"puts_batched           : {per_step(b - a):6.2f} ms/step ({(b-a)/d*100:5.1f}%)")
-    print(f"branch-overflow walks  : {per_step(c - b):6.2f} ms/step ({(c-b)/d*100:5.1f}%)")
-    print(f"walks_batched          : {per_step(d - c):6.2f} ms/step ({(d-c)/d*100:5.1f}%)")
+def _argv():
+    out = ["ablate"]
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    flags = [a for a in sys.argv[1:] if a.startswith("-")]
+    if len(pos) >= 1:
+        out += ["--k", pos[0]]
+    if len(pos) >= 2:
+        out += ["--t", pos[1]]
+    reps = os.environ.get("CEP_PROFILE_REPS")
+    if reps:
+        out += ["--reps", reps]
+    return out + flags
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(_argv()))

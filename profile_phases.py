@@ -1,151 +1,15 @@
-"""Per-phase cost breakdown of the engine step (VERDICT r2 item 1).
+"""Thin wrapper — the profiler moved into the package CLI.
 
-Times each batched slab kernel standalone, vmapped over K lanes, on the
-real device, and prints XLA's bytes/flops estimates. Diagnostics to stderr.
+``python profile_phases.py`` ≡ ``python -m kafkastreams_cep_tpu.profile
+phases`` (standalone slab-kernel timings; out-of-context — prefer
+``ablate`` before optimization decisions, see PROFILE_r04.md).
 """
 import os
 import sys
-import time
 
-import jax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.expanduser("~"), ".cache", "cep_tpu_bench_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-
-import jax.numpy as jnp
-import numpy as np
-
-sys.path.insert(0, ".")
-from kafkastreams_cep_tpu.ops import slab as slab_mod
-
-
-def log(m):
-    print(m, file=sys.stderr, flush=True)
-
-
-K, R, H, E, MP, D, W = 4096, 24, 2, 48, 8, 12, 12
-RH = R * H
-PW = 3 * R  # merged walkers
-
-rng = np.random.default_rng(0)
-
-
-def mk_slab():
-    # Caveat: this random slab is internally inconsistent (dangling pstage
-    # pointers, refs on free entries), so data-dependent walk trip counts
-    # here understate real load — use profile_ablate.py (ablation inside the
-    # real scan) before optimization decisions; see PROFILE_r04.md.
-    i32 = jnp.int32
-    n_live = E // 2
-    stage = np.full((K, E), -1, np.int32)
-    stage[:, :n_live] = rng.integers(0, 4, (K, n_live))
-    off = np.full((K, E), -1, np.int32)
-    off[:, :n_live] = rng.integers(0, 100, (K, n_live))
-    return slab_mod.SlabState(
-        stage=jnp.asarray(stage),
-        off=jnp.asarray(off),
-        refs=jnp.asarray(rng.integers(0, 3, (K, E)), i32),
-        npreds=jnp.asarray(rng.integers(0, MP, (K, E)), i32),
-        pstage=jnp.asarray(rng.integers(-1, 4, (K, E, MP)), i32),
-        poff=jnp.asarray(rng.integers(0, 100, (K, E, MP)), i32),
-        pver=jnp.asarray(rng.integers(0, 3, (K, E, MP, D)), i32),
-        pvlen=jnp.asarray(rng.integers(1, 4, (K, E, MP)), i32),
-        full_drops=jnp.zeros((K,), i32),
-        pred_drops=jnp.zeros((K,), i32),
-        missing=jnp.zeros((K,), i32),
-        trunc=jnp.zeros((K,), i32),
-        collisions=jnp.zeros((K,), i32),
-        hot_hits=jnp.zeros((K,), i32),
-        hot_misses=jnp.zeros((K,), i32),
-        overflow_walks=jnp.zeros((K,), i32),
-        demotions=jnp.zeros((K,), i32),
-        walk_hops=jnp.zeros((K,), i32),
-        extract_hops=jnp.zeros((K,), i32),
-        drain_hops=jnp.zeros((K,), i32),
-    )
-
-
-def bench(name, fn, *args):
-    jfn = jax.jit(fn)
-    lowered = jfn.lower(*args)
-    comp = lowered.compile()
-    ca = comp.cost_analysis()
-    if isinstance(ca, list):
-        ca = ca[0]
-    ca = ca or {}  # some backends return None — timing still prints
-    out = jfn(*args)
-    jax.block_until_ready(out)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = jfn(*args)
-        jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
-    log(
-        f"{name:16s}: {best * 1e3:7.2f} ms   bytes={ca.get('bytes accessed', 0):.2e} "
-        f"flops={ca.get('flops', 0):.2e}  -> {ca.get('bytes accessed', 0) / best / 1e9:.0f} GB/s achieved"
-    )
-    return best
-
-
-def main():
-    i32 = jnp.int32
-    slab = mk_slab()
-    off = jnp.asarray(rng.integers(100, 200, (K,)), i32)
-
-    ops = slab_mod.PutOps(
-        en=jnp.asarray(rng.random((K, RH)) < 0.1),
-        first=jnp.asarray(rng.random((K, RH)) < 0.3),
-        cur_stage=jnp.asarray(rng.integers(0, 4, (K, RH)), i32),
-        prev_stage=jnp.asarray(rng.integers(-1, 4, (K, RH)), i32),
-        prev_off=jnp.asarray(rng.integers(0, 100, (K, RH)), i32),
-        ver=jnp.asarray(rng.integers(0, 3, (K, RH, D)), i32),
-        vlen=jnp.asarray(rng.integers(1, 4, (K, RH)), i32),
-    )
-    bench(
-        "puts_batched",
-        jax.vmap(lambda s, o, f: slab_mod.puts_batched(s, o, f)),
-        slab, ops, off,
-    )
-
-    en_b = jnp.asarray(rng.random((K, R)) < 0.15)
-    st_b = jnp.asarray(rng.integers(0, 4, (K, R)), i32)
-    off_b = jnp.asarray(rng.integers(0, 100, (K, R)), i32)
-    ver_b = jnp.asarray(rng.integers(0, 3, (K, R, D)), i32)
-    vlen_b = jnp.asarray(rng.integers(1, 4, (K, R)), i32)
-    bench(
-        "branch_batched",
-        jax.vmap(
-            lambda s, e, st, o, v, vl: slab_mod.branch_batched(s, e, st, o, v, vl, W)
-        ),
-        slab, en_b, st_b, off_b, ver_b, vlen_b,
-    )
-
-    en_w = jnp.asarray(rng.random((K, PW)) < 0.15)
-    st_w = jnp.asarray(rng.integers(0, 4, (K, PW)), i32)
-    off_w = jnp.asarray(rng.integers(0, 100, (K, PW)), i32)
-    ver_w = jnp.asarray(rng.integers(0, 3, (K, PW, D)), i32)
-    vlen_w = jnp.asarray(rng.integers(1, 4, (K, PW)), i32)
-    is_rm = jnp.concatenate(
-        [jnp.zeros((K, R), bool), jnp.ones((K, 2 * R), bool)], axis=1
-    )
-    want = jnp.concatenate(
-        [jnp.zeros((K, 2 * R), bool), jnp.ones((K, R), bool)], axis=1
-    )
-    bench(
-        "walks_batched",
-        jax.vmap(
-            lambda s, e, st, o, v, vl, ir, wo: slab_mod.walks_batched(
-                s, e, st, o, v, vl, ir, wo, W
-            )
-        ),
-        slab, en_w, st_w, off_w, ver_w, vlen_w, is_rm, want,
-    )
-
+from kafkastreams_cep_tpu.profile import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["phases"] + sys.argv[1:]))

@@ -1,81 +1,15 @@
-"""Round-3 profiling: where does the 45ms/step go?
+"""Thin wrapper — the profiler moved into the package CLI.
 
-Phase A: K-scaling — flat step time => dispatch/op-count bound;
-linear => bandwidth bound.
-Phase B: per-phase cost via ablated step builds.
-Diagnostics to stderr.
+``python profile_step.py`` ≡ ``python -m kafkastreams_cep_tpu.profile
+step`` (structured PROFILE JSON on stdout, diagnostics on stderr).  Size
+via ``--k/--t/--reps`` or the historical ``PROF_T`` env var.
 """
 import os
 import sys
-import time
-
-import jax
-
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.expanduser("~"), ".cache", "cep_tpu_bench_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-
-import jax.numpy as jnp
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples"))
 
-import stock_demo
-from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
-from kafkastreams_cep_tpu.parallel import BatchMatcher
-
-
-def log(m):
-    print(m, file=sys.stderr, flush=True)
-
-
-def make_batch(rng, K, T):
-    prices = rng.integers(90, 131, size=(K, T)).astype(np.int32)
-    volumes = rng.integers(600, 1101, size=(K, T)).astype(np.int32)
-    return EventBatch(
-        key=jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
-        value={"price": jnp.asarray(prices), "volume": jnp.asarray(volumes)},
-        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :] * 2, (K, T)),
-        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
-        valid=jnp.ones((K, T), bool),
-    )
-
-
-def time_scan(K, T, cfg, reps=2):
-    batch = BatchMatcher(stock_demo.stock_pattern(), K, cfg)
-    state0 = batch.init_state()
-    rng = np.random.default_rng(42)
-    events = make_batch(rng, K, T)
-    t0 = time.perf_counter()
-    state, out = batch.scan(state0, events)
-    jax.block_until_ready(out.count)
-    compile_s = time.perf_counter() - t0
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        state, out = batch.scan(state0, events)
-        jax.block_until_ready(out.count)
-        best = min(best, time.perf_counter() - t0)
-    return best, compile_s
-
-
-def main():
-    T = int(os.environ.get("PROF_T", "32"))
-    cfg = EngineConfig(
-        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12, max_walk=12
-    )
-    for K in (512, 4096, 16384):
-        best, comp = time_scan(K, T, cfg)
-        log(
-            f"K={K:6d} T={T}: scan {best * 1e3:8.1f} ms "
-            f"({best / T * 1e3:6.2f} ms/step, {K * T / best / 1e3:8.0f}K ev/s) "
-            f"[compile {comp:.0f}s]"
-        )
-
+from kafkastreams_cep_tpu.profile import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["step"] + sys.argv[1:]))

@@ -1,0 +1,124 @@
+"""Bench regression gate + profiler CLI — tier-1 smoke (ISSUE 6 satellite).
+
+The gate must accept the repo's real BENCH_r01→r05 trajectory replayed
+against itself unchanged, pass on a fixture equal to its baseline, and
+reject a fixture with an injected 2× slowdown.  The profiler CLI must
+emit one parseable PROFILE JSON object with the per-stage selectivity
+table on a tiny synthetic trace.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, _ROOT)
+
+import bench_gate
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def test_gate_passes_on_equal_input():
+    ok, report = bench_gate.gate_paths(
+        _fx("bench_equal.json"), [_fx("bench_base.json")]
+    )
+    assert ok, report
+    metrics = {c["metric"] for c in report["checks"]}
+    assert {"value", "lossfree_evps", "lossfree_counters_zero"} <= metrics
+    assert all(c["ok"] for c in report["checks"])
+
+
+def test_gate_rejects_injected_2x_slowdown():
+    ok, report = bench_gate.gate_paths(
+        _fx("bench_slow_2x.json"), [_fx("bench_base.json")]
+    )
+    assert not ok
+    bad = {c["metric"] for c in report["checks"] if not c["ok"]}
+    assert {"value", "lossfree_evps"} <= bad
+
+
+def test_gate_rejects_loss_flag_regression(tmp_path):
+    doc = json.load(open(_fx("bench_equal.json")))
+    doc["parsed"]["lossfree_counters_zero"] = False
+    p = tmp_path / "lossy.json"
+    p.write_text(json.dumps(doc))
+    ok, report = bench_gate.gate_paths(str(p), [_fx("bench_base.json")])
+    assert not ok
+    assert any(
+        c["metric"] == "lossfree_counters_zero" and not c["ok"]
+        for c in report["checks"]
+    )
+
+
+def test_gate_accepts_real_trajectory_unchanged():
+    """Each round gated against all earlier rounds must pass — the gate
+    would have accepted the project's own history."""
+    paths = sorted(glob.glob(os.path.join(_ROOT, "BENCH_r0*.json")))
+    assert len(paths) >= 5
+    docs = [bench_gate.load_doc(p) for p in paths]
+    for k in range(1, len(docs)):
+        ok, report = bench_gate.gate(docs[k], docs[:k])
+        assert ok, (paths[k], report)
+
+
+def test_gate_tolerates_noise_within_spread():
+    base = bench_gate.load_doc(_fx("bench_base.json"))
+    noisy = json.loads(json.dumps(base))
+    noisy["parsed"]["value"] *= 0.95  # inside the 10% default tolerance
+    ok, _ = bench_gate.gate(noisy, [base])
+    assert ok
+    worse = json.loads(json.dumps(base))
+    worse["parsed"]["value"] *= 0.80  # outside it
+    ok, _ = bench_gate.gate(worse, [base])
+    assert not ok
+
+
+def test_gate_cli_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench_gate.py"),
+         _fx("bench_equal.json"), _fx("bench_base.json")],
+        capture_output=True, text=True, env=env,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    json.loads(ok.stdout)  # the verdict is machine-readable
+    bad = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench_gate.py"),
+         _fx("bench_slow_2x.json"), "--trajectory",
+         os.path.join(FIXTURES, "bench_base.json")],
+        capture_output=True, text=True, env=env,
+    )
+    assert bad.returncode == 1
+
+
+def test_profiler_cli_selectivity_smoke():
+    """``python -m kafkastreams_cep_tpu.profile selectivity`` on a tiny
+    synthetic trace: one JSON object on stdout with the per-stage
+    selectivity table and the attribution-overhead A/B."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "kafkastreams_cep_tpu.profile",
+         "selectivity", "--k", "8", "--t", "16", "--reps", "1",
+         "--platform", "cpu"],
+        capture_output=True, text=True, cwd=_ROOT, env=env, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["profile"] == "selectivity"
+    assert doc["evps_attr_on"] > 0 and doc["evps_attr_off"] > 0
+    per_stage = doc["per_stage"]
+    assert per_stage, "per-stage table must not be empty"
+    row = next(iter(per_stage.values()))
+    for key in ("stage_evals", "stage_accepts", "stage_ignores",
+                "stage_rejects", "stage_walk_hops", "selectivity"):
+        assert key in row
+    assert "top" in doc["per_key"]
