@@ -34,8 +34,10 @@ bounded-skew shuffled arrival through the watermark reorder buffer,
 default 1; ``CEP_BENCH_OOO_{K,B,BATCHES,GRACE}`` size it),
 ``CEP_BENCH_METRICS=1`` (run the headline config
 under the telemetry Reporter and print the per-phase p50/p99 block;
-``CEP_BENCH_METRICS_{K,T,BATCHES}`` size it), ``CEP_PLATFORM`` (force a
-JAX platform, e.g. ``cpu``).
+``CEP_BENCH_METRICS_{K,T,BATCHES}`` size it), ``CEP_BENCH_TIER``
+(compiler-tiering A/B: untiered vs tiered on a strict-prefix-dominated
+match-sparse trace, default 1; ``CEP_BENCH_TIER_{K,T,CHUNK,REPS}`` size
+it), ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -697,6 +699,144 @@ def bench_frontier(K, T, reps, events, base_cfg, spec):
             f"hot-hit rate {rate:.3f}")
         del batch, state0, state, out
     return pts
+
+
+def bench_tier():
+    """``CEP_BENCH_TIER``: compiler-tiering A/B (ISSUE 7).
+
+    Strict-prefix-dominated, match-sparse workload — the production-
+    monitoring shape: a 3-strict-stage prefix + skip-till-next suffix
+    over a 64-symbol alphabet, so the begin predicate rejects ~98% of
+    events and full prefixes fire ~4e-6/event; a handful of complete
+    occurrences are planted so match parity is non-vacuous.  Untiered
+    vs tiered BatchMatcher at identical shapes and chunk cadence (the
+    processor's batch granularity, where the tiered matcher's NFA skip
+    gate operates).  Reports ev/s both ways, the screened-event
+    fraction, the NFA dispatch fraction, and a match-parity flag; both
+    sides must finish loss-free (all counters zero) for the speedup to
+    count.
+    """
+    from kafkastreams_cep_tpu.parallel.tiered import TieredBatchMatcher
+
+    K = int(os.environ.get("CEP_BENCH_TIER_K", "32"))
+    T = int(os.environ.get("CEP_BENCH_TIER_T", "4096"))
+    chunk = int(os.environ.get("CEP_BENCH_TIER_CHUNK", "128"))
+    reps = int(os.environ.get("CEP_BENCH_TIER_REPS", "3"))
+    pattern = (
+        Query()
+        .select("pa").where(lambda k, v, ts, st: v == 1)
+        .then()
+        .select("pb").where(lambda k, v, ts, st: v == 2)
+        .then()
+        .select("pc").where(lambda k, v, ts, st: v == 3)
+        .then()
+        .select("sd").skip_till_next_match()
+        .where(lambda k, v, ts, st: v == 7)
+        .build()
+    )
+    rng = np.random.default_rng(17)
+    codes = rng.integers(8, 64, size=(K, T)).astype(np.int32)
+    # Planted full occurrences, clustered into a few chunks: most batches
+    # then skip the NFA dispatch entirely (the match-sparse production
+    # shape), while the hit chunks keep match parity non-vacuous.
+    n_chunks = max(T // chunk, 1)
+    hot_chunks = sorted(
+        rng.choice(n_chunks, size=min(3, n_chunks), replace=False)
+    )
+    for i in range(12):
+        c = int(hot_chunks[i % len(hot_chunks)])
+        k = int(rng.integers(0, K))
+        t = c * chunk + int(rng.integers(0, max(chunk - 16, 1)))
+        codes[k, t], codes[k, t + 1], codes[k, t + 2] = 1, 2, 3
+        codes[k, t + 9] = 7
+    cfg = EngineConfig(
+        max_runs=32, slab_entries=64, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    tcfg = __import__("dataclasses").replace(cfg, tiering=True)
+    events = EventBatch(
+        key=jnp.zeros((K, T), jnp.int32),
+        value=jnp.asarray(codes),
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+
+    def _chunked_scan_tier(batch):
+        # Same consumed-reduction contract as _chunked_scan: every chunk's
+        # outputs materialize inside the span (int() pulls the reduction,
+        # block_until_ready fences the final state).
+        state = batch.init_state()
+        n = 0
+        hits = []
+        for t0 in range(0, T, chunk):
+            ev = jax.tree_util.tree_map(
+                lambda x: x[:, t0:t0 + chunk], events
+            )
+            state, out = batch.scan(state, ev)
+            n += int(jnp.sum(out.count > 0))  # consumed reduction
+            ct = np.asarray(out.count)
+            for k, t, r in zip(*np.nonzero(ct)):
+                hits.append((int(k), t0 + int(t), int(ct[k, t, r])))
+        jax.block_until_ready(
+            state.slab.stage
+            if not hasattr(state, "engine")
+            else state.engine.slab.stage
+        )
+        return state, n, sorted(hits)
+
+    runs = {}
+    for label, b in (
+        ("untiered", BatchMatcher(pattern, K, cfg)),
+        ("tiered", TieredBatchMatcher(pattern, K, tcfg)),
+    ):
+        t0 = time.perf_counter()
+        state, n, hits = _chunked_scan_tier(b)
+        log(f"tier[{label}]: compile+first {time.perf_counter() - t0:.1f}s")
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            state, n, hits = _chunked_scan_tier(b)
+            best = min(best, time.perf_counter() - t0)
+        runs[label] = (b, state, n, hits, best)
+    (ub, us, un, uh, ubest) = runs["untiered"]
+    (tb, ts_, tn, th, tbest) = runs["tiered"]
+    uc, tc = ub.counters(us), tb.counters(ts_)
+    tier = tb.tier_counters(ts_)
+    screened = tier["prefix_events_screened"]
+    fires = tier["prefix_fires"]
+    parity = uh == th and uc == tc
+    zero = all(v == 0 for v in uc.values()) and all(
+        v == 0 for v in tc.values()
+    )
+    dispatch_frac = (
+        tb.nfa_dispatches / tb.scan_calls if tb.scan_calls else 0.0
+    )
+    out = {
+        "k": K, "t": T, "chunk": chunk,
+        "plan": tb.plan.describe(),
+        "untiered_evps": round(K * T / ubest, 1),
+        "tiered_evps": round(K * T / tbest, 1),
+        "speedup": round(ubest / tbest, 3),
+        "screened_fraction": (
+            round(1.0 - fires / screened, 6) if screened else None
+        ),
+        "prefix_fires": fires,
+        "tier_promotions": tier["tier_promotions"],
+        "nfa_dispatch_fraction": round(dispatch_frac, 4),
+        "match_slots": un,
+        "match_parity": bool(parity),
+        "counters_zero": bool(zero),
+    }
+    log(
+        f"tier A/B ({K}x{T}, chunk={chunk}, {tb.plan.tier} "
+        f"p={tb.plan.prefix_len}): untiered {K * T / ubest / 1e3:.0f}K "
+        f"ev/s vs tiered {K * T / tbest / 1e3:.0f}K ev/s "
+        f"({ubest / tbest:.2f}x); screened {out['screened_fraction']}, "
+        f"NFA dispatched {dispatch_frac:.1%} of batches, "
+        f"{un} vs {tn} match slots (parity={parity}, zero={zero})"
+    )
+    return out
 
 
 def bench_stencil(total_events, reps):
@@ -1383,9 +1523,18 @@ def main():
     resilience = {}
     proc_phases = {}
     ooo = {}
+    tier = {}
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
         budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "1200"))
         extras = [
+            (
+                "tier",
+                lambda: tier.update(
+                    bench_tier()
+                    if os.environ.get("CEP_BENCH_TIER", "1") == "1"
+                    else {}
+                ),
+            ),
             (
                 "ooo",
                 lambda: ooo.update(
@@ -1534,6 +1683,11 @@ def main():
                 # buffer — reorder overhead, match parity, loss counters
                 # (None when extras are skipped or CEP_BENCH_OOO=0).
                 "ooo": ooo or None,
+                # Compiler-tiering A/B (ISSUE 7): untiered vs tiered on a
+                # strict-prefix-dominated match-sparse trace — speedup,
+                # screened-event fraction, NFA dispatch fraction, match
+                # parity (None when extras skipped or CEP_BENCH_TIER=0).
+                "tier": tier or None,
             }
         ),
         flush=True,

@@ -42,8 +42,16 @@ from typing import Any, Dict, List, Optional, Tuple
 
 #: Throughput metrics guarded for "not meaningfully lower".
 RATE_METRICS = ("value", "lossfree_evps")
-#: Boolean metrics guarded for "never true -> false".
-FLAG_METRICS = ("lossfree_counters_zero", "lossfree_oracle_parity")
+#: Boolean metrics guarded for "never true -> false".  The ``tier_*``
+#: flags flatten out of the headline's nested ``tier`` block (compiler
+#: tiering, BENCH_r06+): once a round records tiered/untiered match
+#: parity on loss-free state, later rounds may not regress it.
+FLAG_METRICS = (
+    "lossfree_counters_zero",
+    "lossfree_oracle_parity",
+    "tier_match_parity",
+    "tier_counters_zero",
+)
 #: Ratio metrics guarded like rates (0..1, higher is better).
 RATIO_METRICS = ("recall_sampled",)
 
@@ -59,8 +67,14 @@ def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         v = parsed.get(k)
         if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
             out[k] = float(v)
+    tier = parsed.get("tier")
+    flat = dict(parsed)
+    if isinstance(tier, dict):
+        # Nested tier block -> flat ``tier_*`` keys for the flag guard.
+        flat["tier_match_parity"] = tier.get("match_parity")
+        flat["tier_counters_zero"] = tier.get("counters_zero")
     for k in FLAG_METRICS:
-        v = parsed.get(k)
+        v = flat.get(k)
         if isinstance(v, bool):
             out[k] = v
     sp = parsed.get("spread_pct")

@@ -5,5 +5,27 @@ from kafkastreams_cep_tpu.compiler.stages import (
     EdgeOperation,
     compile_pattern,
 )
+from kafkastreams_cep_tpu.compiler.tiering import (
+    TIER_HYBRID,
+    TIER_NFA,
+    TIER_STENCIL,
+    TieringPlan,
+    apply_lazy_order,
+    plan_tiering,
+    strict_prefix_len,
+)
 
-__all__ = ["Stage", "StageType", "Edge", "EdgeOperation", "compile_pattern"]
+__all__ = [
+    "Stage",
+    "StageType",
+    "Edge",
+    "EdgeOperation",
+    "TIER_HYBRID",
+    "TIER_NFA",
+    "TIER_STENCIL",
+    "TieringPlan",
+    "apply_lazy_order",
+    "compile_pattern",
+    "plan_tiering",
+    "strict_prefix_len",
+]

@@ -1,0 +1,322 @@
+"""Compiler tiering: split each query at its maximal strict prefix.
+
+The stencil fast path (``engine/stencil.py``) runs branch-free
+strict-contiguity sequences two orders of magnitude faster than the
+general NFA+slab engine — but only whole patterns qualified.  This pass
+generalizes the split: per the DFA-vs-NFA automata-processing results
+(arxiv 2210.10077) strict-contiguity fragments determinize cheaply, so
+every query is split into
+
+* its **maximal strict prefix** — the longest run of leading chain
+  positions whose consuming edge is BEGIN with no IGNORE, no PROCEED, and
+  no folds (every such position is exactly one stencil column), and
+* the **residual suffix** — everything from the first Kleene/skip-till/
+  fold stage on, which keeps the full NFA semantics.
+
+The hybrid matcher (``parallel/tiered.py``) runs the prefix as a
+data-parallel stencil over the whole ``[K, T]`` batch and *promotes* a
+run into the NFA tier only at events where the prefix completes — events
+the begin predicate rejects, and events consumed inside the prefix, never
+cost a run-queue slot, a slab put, or a walk hop.
+
+Window no-prune proof (asserted here, not assumed)
+--------------------------------------------------
+The stencil tier cannot prune by ``within()`` windows.  That is *correct*
+under the faithful engine because every non-seed run in the reference is
+an epsilon wrapper that never carries ``windowMs`` (``Stage.java:41-46``),
+so ``isOutOfWindow`` can never fire — windows never prune.  Under
+``EngineConfig.enforce_windows=True`` that proof fails (the engine opts
+into functional pruning, including *inside* the prefix via inherited
+windows), so :func:`plan_tiering` refuses to route a windowed pattern to
+the stencil tier and degrades to the whole-NFA plan instead of silently
+relying on the invariant.
+
+Lazy-chain predicate ordering (arxiv 1612.05110)
+------------------------------------------------
+The same pass emits an evaluation order for each stage's conjunct chain:
+``and_`` combinators record their operands (``pattern/predicate.py``), so
+a stage predicate flattens into a commuting conjunct list which
+:func:`apply_lazy_order` reorders so cheap, selective conjuncts gate
+expensive ones.  Rank = estimated selectivity × estimated cost,
+ascending: selectivity comes from the measured ``stage_attribution``
+profile (PR 6's ``per_stage`` snapshot — ``metrics_snapshot()["per_stage"]``
+or the profiler CLI's ``selectivity`` output) via per-conjunct
+``selectivity_hint`` overrides, and cost from a static model
+(``cost_hint`` if declared, else bytecode length of the closure).
+Reordering a conjunction is semantics-preserving by commutativity; the
+property test in ``tests/test_tiering.py`` pins that accept/ignore/reject
+tallies and matches are bit-identical either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kafkastreams_cep_tpu.compiler.tables import (
+    OP_BEGIN,
+    TransitionTables,
+    lower,
+)
+from kafkastreams_cep_tpu.pattern.predicate import Matcher, _normalize
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("compiler.tiering")
+
+# Tier labels — also the per-query ``tier=...`` tag in the profiler CLI.
+TIER_STENCIL = "stencil"  # whole pattern on the stencil tier, no NFA
+TIER_HYBRID = "hybrid"  # strict prefix on the stencil, suffix on the NFA
+TIER_NFA = "nfa"  # no usable prefix: whole-NFA execution
+
+
+@dataclasses.dataclass(frozen=True)
+class TieringPlan:
+    """One query's tier routing decision, host-side and immutable."""
+
+    tier: str  # TIER_STENCIL | TIER_HYBRID | TIER_NFA
+    prefix_len: int  # stages routed to the stencil tier (0 for TIER_NFA)
+    reason: str  # why the plan is what it is (telemetry / debugging)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "tier": self.tier,
+            "prefix_len": self.prefix_len,
+            "reason": self.reason,
+        }
+
+
+def strict_prefix_len(tables: TransitionTables) -> int:
+    """The maximal strict-contiguity prefix of ``tables``: leading chain
+    positions consuming via BEGIN with no IGNORE edge, no PROCEED edge,
+    and no fold registered at the position.  Each such position is one
+    stencil column (``TransitionTables.is_strict_seq`` is the
+    whole-pattern special case: prefix == num_stages - 1)."""
+    agg_stages = {slot.stage for slot in tables.aggs}
+    n = tables.num_stages - 1  # exclude $final
+    p = 0
+    for j in range(n):
+        if (
+            tables.consume_op[j] != OP_BEGIN
+            or tables.ignore_pred[j] >= 0
+            or tables.proceed_pred[j] >= 0
+            or j in agg_stages
+        ):
+            break
+        p += 1
+    return p
+
+
+def check_no_prune(tables: TransitionTables, config) -> Optional[str]:
+    """The window no-prune proof for routing a prefix onto the stencil
+    tier.  Returns ``None`` when the proof holds, else the reason it
+    fails.  Faithful mode (``enforce_windows=False``): epsilon wrappers
+    never carry ``windowMs``, so ``within()`` never prunes — holds for
+    any pattern, windowed or not.  ``enforce_windows=True`` opts into
+    functional pruning the stencil does not implement (a partial prefix
+    run can be pruned mid-prefix via inherited windows), so any set
+    window fails the proof."""
+    if not getattr(config, "enforce_windows", False):
+        return None
+    if np.any(tables.window_ms != -1):
+        w = int(tables.window_ms[tables.window_ms != -1].max())
+        return (
+            f"enforce_windows=True with a {w} ms within() window: "
+            "functional pruning can fire inside the prefix, which the "
+            "stencil tier cannot reproduce"
+        )
+    return None
+
+
+def plan_tiering(
+    pattern_or_tables, config=None, profile: Optional[Dict] = None
+) -> TieringPlan:
+    """Decide the tier split for one compiled query under ``config``.
+
+    Constraints beyond :func:`strict_prefix_len`:
+
+    * the no-prune proof must hold (:func:`check_no_prune`) — else the
+      whole query stays NFA;
+    * ``prefix_len <= dewey_depth``: inside the prefix a run appends one
+      stage digit per crossing, and promotion must inject a version the
+      untiered run would carry without ever having overflowed;
+    * pure-stencil routing needs ``prefix_len <= max_walk`` (the
+      synthesized match rows stand in for a W-bounded extraction walk)
+      and is off under ``lazy_extraction`` (pure-stencil matches emit
+      eagerly; capping to a hybrid keeps the handle-ring contract) — both
+      degrade to the hybrid split, never to silent truncation.
+
+    ``profile`` is accepted for parity with :func:`apply_lazy_order` (a
+    measured ``per_stage`` snapshot); the split itself is structural.
+    """
+    tables = (
+        pattern_or_tables
+        if isinstance(pattern_or_tables, TransitionTables)
+        else lower(pattern_or_tables)
+    )
+    del profile  # the split is structural; ordering consumes the profile
+    n = tables.num_stages - 1
+    p = strict_prefix_len(tables)
+    if p == 0:
+        return TieringPlan(TIER_NFA, 0, "no strict-contiguity prefix")
+    no_prune = check_no_prune(tables, config) if config is not None else None
+    if no_prune is not None:
+        return TieringPlan(TIER_NFA, 0, f"no-prune proof failed: {no_prune}")
+    reason = f"maximal strict prefix {p}/{n}"
+    if config is not None and p > config.dewey_depth:
+        p = int(config.dewey_depth)
+        reason += f", capped to dewey_depth={p}"
+        if p == 0:
+            return TieringPlan(TIER_NFA, 0, reason)
+    if p == n:
+        if config is not None and getattr(config, "lazy_extraction", False):
+            p = n - 1
+            reason += ", capped below n (lazy_extraction drains via the NFA)"
+        elif config is not None and p > config.max_walk:
+            p = n - 1
+            reason += f", capped below n (max_walk={config.max_walk} < n)"
+        else:
+            return TieringPlan(TIER_STENCIL, p, reason + " (whole pattern)")
+    if p == 0:
+        return TieringPlan(TIER_NFA, 0, reason)
+    return TieringPlan(TIER_HYBRID, p, reason)
+
+
+# ---------------------------------------------------------------------------
+# Lazy-chain predicate ordering
+# ---------------------------------------------------------------------------
+
+
+def conjuncts(matcher: Matcher) -> List[Matcher]:
+    """Flatten an ``and_`` combinator tree into its commuting conjunct
+    list (left-to-right declaration order).  Anything that is not an
+    ``and_`` node — including ``or_``/``not_`` subtrees, which do not
+    commute with the conjunction boundary — is one opaque conjunct."""
+    if getattr(matcher, "op", None) == "and":
+        out: List[Matcher] = []
+        for part in matcher.parts:
+            out.extend(conjuncts(part))
+        return out
+    return [matcher]
+
+
+def predicate_cost(matcher: Matcher) -> float:
+    """Static relative cost of evaluating ``matcher`` once.
+
+    ``cost_hint`` wins when declared; combinators sum their parts; plain
+    matchers fall back to the bytecode length of their closure — a crude
+    but monotone proxy for trace-time op count that needs no execution."""
+    if getattr(matcher, "cost_hint", None) is not None:
+        return float(matcher.cost_hint)
+    parts = getattr(matcher, "parts", ())
+    if parts:
+        return sum(predicate_cost(p) for p in parts)
+    code = getattr(matcher.fn, "__code__", None)
+    if code is None:  # builtins / partials: flat default
+        return 16.0
+    return float(len(code.co_code))
+
+
+def _conjunct_selectivity(m: Matcher, stage_sel: Optional[float]) -> float:
+    """Estimated accept fraction of one conjunct: its declared hint, else
+    the stage's measured selectivity (every conjunct of the stage then
+    ties and cost alone decides), else 0.5."""
+    if getattr(m, "selectivity_hint", None) is not None:
+        return float(m.selectivity_hint)
+    if stage_sel is not None:
+        return float(stage_sel)
+    return 0.5
+
+
+def order_conjuncts(
+    matcher: Matcher, stage_sel: Optional[float] = None
+) -> Tuple[List[Matcher], bool]:
+    """The lazy-chain order for one stage predicate: conjuncts ranked by
+    estimated ``selectivity × cost`` ascending (cheap selective gates
+    first — the expected-work ordering of arxiv 1612.05110's lazy
+    chains), stable within ties.  Returns ``(ordered, changed)``."""
+    parts = conjuncts(matcher)
+    if len(parts) < 2:
+        return parts, False
+    ranked = sorted(
+        range(len(parts)),
+        key=lambda i: (
+            _conjunct_selectivity(parts[i], stage_sel)
+            * predicate_cost(parts[i]),
+            i,
+        ),
+    )
+    ordered = [parts[i] for i in ranked]
+    return ordered, ranked != list(range(len(parts)))
+
+
+def _ordered_and(parts: List[Matcher]) -> Matcher:
+    """Rebuild a conjunction evaluating ``parts`` in list order: host
+    values short-circuit left-to-right, traced values combine with ``&``
+    in the same order.  Semantically identical to any other order of the
+    same commuting conjuncts."""
+
+    def fn(key, value, timestamp, states):
+        acc: Any = True
+        for p in parts:
+            v = _normalize(p(key, value, timestamp, states))
+            if isinstance(acc, bool) and isinstance(v, bool):
+                if not v:
+                    return False  # host short-circuit, in chain order
+            else:
+                acc = v if acc is True else acc & v
+        return acc
+
+    m = Matcher(fn, label="and(" + ",".join(p.label for p in parts) + ")")
+    m.op = "and"
+    m.parts = tuple(parts)
+    return m
+
+
+def apply_lazy_order(
+    tables: TransitionTables, profile: Optional[Dict] = None
+) -> Tuple[TransitionTables, Dict[str, Any]]:
+    """Reorder every stage's commuting conjunct chain by measured
+    selectivity and static cost.
+
+    ``profile`` is a ``per_stage`` snapshot (``{stage_name:
+    {"selectivity": s, ...}}``) from ``stage_attribution`` telemetry; when
+    absent the static cost model alone ranks the conjuncts.  Only
+    *consuming*-edge predicates are rebuilt (IGNORE/PROCEED predicates
+    are compiler-derived combinations whose structure the engine step
+    depends on for nothing, but which share no reorderable conjunct
+    surface worth the churn).  Returns ``(new_tables, report)`` where
+    ``report[stage] = {"order": [...labels], "reordered": bool,
+    "selectivity": float|None}``; ``new_tables`` shares everything but
+    its predicate dispatch list with the input."""
+    preds = list(tables.predicates)
+    report: Dict[str, Any] = {}
+    changed_any = False
+    n = tables.num_stages - 1
+    for j in range(n):
+        pid = int(tables.consume_pred[j])
+        if pid < 0:
+            continue
+        name = tables.names[j]
+        stage_sel = None
+        if profile and name in profile:
+            row = profile[name]
+            stage_sel = row.get("selectivity") if isinstance(row, dict) else None
+        ordered, changed = order_conjuncts(preds[pid], stage_sel)
+        report[name] = {
+            "order": [m.label for m in ordered],
+            "costs": [round(predicate_cost(m), 1) for m in ordered],
+            "reordered": changed,
+            "selectivity": stage_sel,
+        }
+        if changed:
+            preds[pid] = _ordered_and(ordered)
+            changed_any = True
+    if changed_any:
+        logger.info(
+            "lazy-chain ordering reordered stages: %s",
+            [s for s, r in report.items() if r["reordered"]],
+        )
+    new_tables = dataclasses.replace(tables, predicates=preds)
+    return new_tables, report

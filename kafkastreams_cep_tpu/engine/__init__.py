@@ -21,10 +21,14 @@ from kafkastreams_cep_tpu.engine.sizing import (
     suggest,
 )
 from kafkastreams_cep_tpu.engine.stencil import (
+    PrefixCarry,
+    PromoOutput,
     StencilMatcher,
     StencilOutput,
+    StencilPrefix,
     StencilState,
 )
+from kafkastreams_cep_tpu.engine.tiered import TieredState, engine_view
 
 __all__ = [
     "ArrayStates",
@@ -34,12 +38,17 @@ __all__ = [
     "EscalationPolicy",
     "EventBatch",
     "MatcherSession",
+    "PrefixCarry",
     "ProbeReport",
+    "PromoOutput",
     "StencilMatcher",
     "StencilOutput",
+    "StencilPrefix",
     "StencilState",
     "StepOutput",
     "TPUMatcher",
+    "TieredState",
+    "engine_view",
     "autosize",
     "capacity_counters",
     "escalate",

@@ -194,6 +194,18 @@ class EngineConfig:
     # time: zero new device work.  Not a capacity knob; migration must
     # not flip it (runtime/migrate.py _SEMANTIC_FLAGS).
     stage_attribution: bool = False
+    # Compiler tiering (ROADMAP "route pattern prefixes onto the stencil
+    # path"): when True the runtime builds a TieredBatchMatcher
+    # (parallel/tiered.py) that runs each query's maximal strict-
+    # contiguity prefix on the branch-free stencil tier over the whole
+    # [K, T] batch and promotes runs into this NFA+slab engine only at
+    # events where the prefix completes (compiler/tiering.py).  Matches,
+    # emission order, and loss counters are bit-identical to the untiered
+    # engine on loss-free workloads (tests/test_tiering.py); patterns
+    # with no usable prefix fall back to whole-NFA execution unchanged.
+    # Semantic for state *shape* (the tiered state carries the stencil
+    # carry), so migration must not flip it (runtime/migrate.py).
+    tiering: bool = False
 
 
 class EventBatch(NamedTuple):
@@ -355,6 +367,19 @@ STAGE_TALLY_NAMES = (
     "stage_accepts",
     "stage_ignores",
     "stage_rejects",
+)
+
+# Compiler-tiering telemetry (EngineConfig.tiering): how much traffic the
+# stencil prefix tier absorbed before the NFA tier saw anything.  NOT loss
+# indicators (like the hot/walk counters) — ``prefix_events_screened``
+# counts every valid event the prefix evaluated, ``prefix_fires`` the
+# prefix completions, ``tier_promotions`` the runs actually injected into
+# the NFA tier (fires minus queue-overflow drops).  Untiered matchers
+# report them as structural zeros so dashboards need no per-tier schema.
+TIER_COUNTER_NAMES = (
+    "prefix_events_screened",
+    "prefix_fires",
+    "tier_promotions",
 )
 
 

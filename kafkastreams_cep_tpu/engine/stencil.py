@@ -13,6 +13,10 @@ parallel over keys *and* time (the general engine is sequential over time).
 ``within()`` windows need no handling here for parity: in the reference all
 non-seed runs are epsilon wrappers that never carry ``windowMs``
 (``Stage.java:41-46``), so windows never prune (see ``engine/matcher.py``).
+That invariant is no longer merely noted: the tiering pass *asserts* it at
+compile time (``compiler/tiering.py: check_no_prune``) and refuses to route
+a windowed prefix onto this tier when ``EngineConfig.enforce_windows``
+breaks the proof.
 
 A carry of the last ``n-1`` events' per-stage booleans and offsets makes
 matching exact across micro-batch boundaries.  Conformance: differential
@@ -158,3 +162,193 @@ class StencilMatcher:
                 )
             matches.append((int(k), int(t), seq))
         return matches
+
+
+# ---------------------------------------------------------------------------
+# Prefix mode — the stencil as the first tier of a hybrid matcher
+# ---------------------------------------------------------------------------
+
+
+class PrefixCarry(NamedTuple):
+    """Cross-batch carry of the stencil *prefix* tier (compiler tiering).
+
+    Beyond :class:`StencilState`'s trailing-window booleans/offsets, the
+    prefix tier must be able to *promote* a completing window into the
+    NFA tier with exactly the state an untiered run would carry, so the
+    carry also tracks per-event timestamps (window anchors), the seed
+    Dewey version each window root was born under, and the running
+    begin-accept count that generates those versions.  The three trailing
+    fields are the tier telemetry counters — device state so they
+    checkpoint/migrate/merge like every engine counter.
+    """
+
+    bools: jnp.ndarray  # [K, p-1, p] bool — per-stage predicate values
+    offs: jnp.ndarray  # [K, p-1] int32 — event offsets (-1 = none yet)
+    ts: jnp.ndarray  # [K, p-1] int32 — rebased event timestamps
+    sver: jnp.ndarray  # [K, p-1] int32 — seed version at each event
+    cnt: jnp.ndarray  # [K] int32 — begin-accepts seen (seed ver - 1)
+    screened: jnp.ndarray  # [K] int32 — valid events the prefix screened
+    fires: jnp.ndarray  # [K] int32 — prefix completions
+    promotions: jnp.ndarray  # [K] int32 — runs injected into the NFA tier
+
+
+class PromoOutput(NamedTuple):
+    """Per-step promotion feed for the NFA tier: at every batch slot where
+    the prefix completed (``fire``), the p prefix-event offsets, the
+    window-anchor timestamp, and the first Dewey digit the promoted run
+    must carry (the seed version at the window root)."""
+
+    fire: jnp.ndarray  # [K, T] bool
+    offs: jnp.ndarray  # [K, T, p] int32
+    anchor_ts: jnp.ndarray  # [K, T] int32
+    sver: jnp.ndarray  # [K, T] int32
+
+
+class StencilPrefix:
+    """Stencil evaluation of a query's strict-contiguity *prefix*.
+
+    Generalizes :class:`StencilMatcher` from whole patterns to the leading
+    ``prefix_len`` stages chosen by ``compiler/tiering.py``: ``scan``
+    consumes a ``[K, T]`` :class:`EventBatch` fully parallel over keys and
+    time and emits, per step, whether the prefix completed there plus
+    everything the NFA tier needs to seed the suffix run — the exact
+    Dewey root (``1 + begin-accepts before the window root``, the version
+    the untiered seed would have handed that run), the window anchor
+    (the reference resets the window start while a run's identity stage
+    is BEGIN-typed, so the anchor is the window's second event for
+    ``p >= 2`` and its only event for ``p == 1``), and the p event
+    offsets whose shared-buffer chain the promotion writes.
+
+    Predicates are evaluated against the declared fold-state *inits*:
+    prefix stages carry no folds (by definition of the split), so every
+    untiered prefix run evaluates against exactly those values.
+    """
+
+    def __init__(self, tables, num_lanes: int, prefix_len: int):
+        self.tables: TransitionTables = (
+            tables if isinstance(tables, TransitionTables) else lower(tables)
+        )
+        p = int(prefix_len)
+        n = self.tables.num_stages - 1
+        if not 0 < p <= n:
+            raise ValueError(f"prefix_len={p} outside 1..{n}")
+        if np.any(self.tables.consume_op[:p] != OP_BEGIN) or np.any(
+            self.tables.ignore_pred[:p] >= 0
+        ) or np.any(self.tables.proceed_pred[:p] >= 0) or any(
+            slot.stage < p for slot in self.tables.aggs
+        ):
+            raise ValueError(
+                f"stages [0, {p}) are not a strict-contiguity prefix; run "
+                "compiler.tiering.plan_tiering first"
+            )
+        self.num_lanes = int(num_lanes)
+        self.p = p
+        self._preds = [
+            self.tables.predicates[self.tables.consume_pred[j]]
+            for j in range(p)
+        ]
+        # Fold-state inits (decoded to each state's declared dtype): the
+        # exact ArrayStates view an untiered prefix run evaluates against.
+        self._states = ArrayStates(
+            {
+                name: (
+                    jnp.asarray(init, jnp.float32)
+                    if dt == "float32"
+                    else jnp.asarray(init, jnp.int32)
+                )
+                for name, init, dt in zip(
+                    self.tables.state_names,
+                    self.tables.state_inits,
+                    self.tables.state_dtypes,
+                )
+            }
+        )
+        self.scan = jax.jit(self._scan)
+
+    def init_carry(self) -> PrefixCarry:
+        K, p = self.num_lanes, self.p
+        i32 = jnp.int32
+        z = jnp.zeros((K,), i32)
+        return PrefixCarry(
+            bools=jnp.zeros((K, p - 1, p), bool),
+            offs=jnp.full((K, p - 1), -1, i32),
+            ts=jnp.zeros((K, p - 1), i32),
+            sver=jnp.ones((K, p - 1), i32),
+            cnt=z,
+            screened=z,
+            fires=z,
+            promotions=z,
+        )
+
+    def _scan(
+        self, carry: PrefixCarry, ev: EventBatch
+    ) -> Tuple[PrefixCarry, PromoOutput]:
+        K, p = self.num_lanes, self.p
+        i32 = jnp.int32
+        T = ev.ts.shape[-1]
+        bools = jnp.stack(
+            [
+                jnp.broadcast_to(
+                    jnp.asarray(
+                        pr(ev.key, ev.value, ev.ts, self._states), bool
+                    ),
+                    (K, T),
+                )
+                & ev.valid
+                for pr in self._preds
+            ],
+            axis=-1,
+        )  # [K, T, p]
+        offs = jnp.asarray(ev.off, i32)
+        ts = jnp.asarray(ev.ts, i32)
+        b0 = bools[..., 0]
+        # Seed version at each batch slot: 1 + begin-accepts strictly
+        # before it (the version the untiered seed hands the run it
+        # creates there — the seed bumps on every accept, not only on
+        # completed prefixes).
+        sver = 1 + carry.cnt[:, None] + (
+            jnp.cumsum(b0.astype(i32), axis=1) - b0.astype(i32)
+        )
+
+        ext_b = jnp.concatenate([carry.bools, bools], axis=1)
+        ext_off = jnp.concatenate([carry.offs, offs], axis=1)
+        ext_ts = jnp.concatenate([carry.ts, ts], axis=1)
+        ext_sver = jnp.concatenate([carry.sver, sver], axis=1)
+
+        # fire[k, t] = AND_j ext_b[k, t+j, j]: stage j saw event t-p+1+j.
+        fire = ext_b[:, 0:T, 0]
+        for j in range(1, p):
+            fire = fire & ext_b[:, j : j + T, j]
+        offs_out = jnp.stack(
+            [ext_off[:, j : j + T] for j in range(p)], axis=-1
+        )
+        # Window anchor: the event the untiered run's start_ts settles on
+        # (the second window event for p >= 2 — re-anchored while the run
+        # identity is the BEGIN-typed stage — else the root itself).
+        a = min(1, p - 1)
+        anchor = ext_ts[:, a : a + T]
+        sver_out = ext_sver[:, 0:T]
+
+        # New carry: the trailing p-1 *valid* columns (valid slots form a
+        # per-lane prefix, so they end at column c = carry + valid count).
+        c = jnp.sum(ev.valid, axis=1).astype(i32)
+        carry_b = jax.vmap(
+            lambda row, start: jax.lax.dynamic_slice(
+                row, (start, 0), (p - 1, p)
+            )
+        )(ext_b, c)
+        slice1 = lambda row, start: jax.lax.dynamic_slice(
+            row, (start,), (p - 1,)
+        )
+        new_carry = PrefixCarry(
+            bools=carry_b,
+            offs=jax.vmap(slice1)(ext_off, c),
+            ts=jax.vmap(slice1)(ext_ts, c),
+            sver=jax.vmap(slice1)(ext_sver, c),
+            cnt=carry.cnt + jnp.sum(b0.astype(i32), axis=1),
+            screened=carry.screened
+            + jnp.sum(ev.valid.astype(i32), axis=1),
+            fires=carry.fires + jnp.sum(fire.astype(i32), axis=1),
+            promotions=carry.promotions,
+        )
+        return new_carry, PromoOutput(fire, offs_out, anchor, sver_out)

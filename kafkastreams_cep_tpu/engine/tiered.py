@@ -1,0 +1,232 @@
+"""Tier promotion: seed NFA suffix runs from stencil prefix completions.
+
+The compiler tiering pass (``compiler/tiering.py``) splits a query into a
+strict-contiguity prefix (run by ``engine/stencil.py: StencilPrefix``)
+and a residual NFA suffix.  This module builds the *promotion* step that
+joins the two tiers: at every event where the prefix completes, inject
+into the NFA engine exactly the run — and exactly the shared-buffer
+chain — the untiered engine would hold at that moment, so everything
+downstream (suffix evaluation, branching, extraction, lazy drains,
+checkpoints) is bit-identical by construction.
+
+What "exactly the run" means, traced against ``engine/matcher.py``:
+
+* **Dewey root.**  The untiered seed re-adds itself with ``add_run`` on
+  every event its begin predicate accepts, so the run rooted at window
+  event ``t0`` carries first digit ``v = 1 + accepts-before-t0`` (the
+  stencil tier counts those accepts, ``PrefixCarry.cnt/sver``).  Each
+  stage crossing inside the prefix appends one ``.0`` digit
+  (``NFA.java:185-188``), so at promotion the version is ``[v, 0, ...,
+  0]`` with length ``p`` — provided ``p <= dewey_depth``, which the
+  tiering pass guarantees, no prefix-internal append can ever have
+  overflowed.
+* **Window anchor.**  ``getFirstPatternTimestamp`` re-anchors the window
+  start while the run's identity stage is BEGIN-typed, so the untiered
+  run's ``start_ts`` settles on the *second* window event for ``p >= 2``
+  and the root event for ``p == 1`` — the stencil's ``anchor_ts``.
+* **Queue position.**  Strict prefixes neither branch nor reorder, so
+  suffix runs keep creation order, and creation order equals completion
+  order (fixed prefix length); appending each promotion after the live
+  queue prefix (compaction leaves live runs contiguous) reproduces the
+  untiered queue's relative order — and therefore emission order.
+* **Shared buffer.**  The untiered prefix run wrote ``put_first`` at its
+  root and one chained ``put`` per later stage, under the versions above;
+  the promotion replays those p puts verbatim.  Entries are keyed
+  ``(stage, off)`` and prefix chains are private to their run, so writing
+  them at promotion time instead of spread over p steps changes nothing
+  an op can observe (slot *placement* may differ — never match content).
+
+Partial prefixes — windows that have not completed — exist only as
+stencil carry booleans: no run-queue slot, no slab entry, no walk hop.
+That is the entire point of the tier split; it also means capacity
+counters can only diverge from the untiered engine in regimes where the
+untiered engine was already dropping state (its queue/slab held the
+partials), i.e. outside the loss-free contract both engines are held to.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafkastreams_cep_tpu.engine.matcher import (
+    EngineConfig,
+    EngineState,
+    StepOutput,
+)
+from kafkastreams_cep_tpu.engine.stencil import PrefixCarry, PromoOutput
+from kafkastreams_cep_tpu.ops import slab as slab_mod
+from kafkastreams_cep_tpu.ops.onehot import put_at
+
+
+class TieredState(NamedTuple):
+    """Full tiered-matcher state: the NFA engine state plus the stencil
+    prefix carry.  A pytree, so checkpoints (``runtime/checkpoint.py``),
+    migration (``runtime/migrate.py``), and device placement all compose;
+    runtime code that needs the engine half of *either* state shape uses
+    :func:`engine_view`."""
+
+    engine: EngineState
+    carry: PrefixCarry
+
+
+def engine_view(state):
+    """The :class:`EngineState` inside ``state`` — identity for a bare
+    engine state, the ``engine`` field of a :class:`TieredState`.  The
+    single accessor runtime-layer probes (GC, flight recorder, health)
+    go through so they stay matcher-agnostic."""
+    return getattr(state, "engine", state)
+
+
+def seedless_init(init_state_fn) -> EngineState:
+    """An engine initial state with NO seed run: under tiering the begin
+    stage lives on the stencil tier, so the NFA queue starts empty and
+    only promotions populate it.  Derived from the standard init by
+    clearing run 0 (the seed slot) back to compaction fill values."""
+    s = init_state_fn()
+    i32 = jnp.int32
+    R = s.alive.shape[0]
+    return s._replace(
+        alive=jnp.zeros((R,), bool),
+        eval_pos=jnp.zeros((R,), i32),
+        ver=jnp.zeros_like(s.ver),
+        vlen=jnp.zeros((R,), i32),
+    )
+
+
+def build_promote(tables, cfg: EngineConfig, prefix_len: int):
+    """Compile the per-lane promotion step for one tiering plan.
+
+    Returns a pure jittable ``promote(state, fire, offs, anchor_ts, sver)
+    -> (state, n_promoted)`` that, when ``fire``:
+
+    1. replays the prefix chain's shared-buffer writes (``put_first`` at
+       the root, chained ``put`` per later stage) under the promoted
+       Dewey versions;
+    2. appends the suffix run — identity ``ident[p-1]``, eval position
+       ``consume_target[p-1]``, version ``[v, 0...]``/len ``p``, pointer
+       event = the completing prefix event, window start = the anchor —
+       after the live queue prefix;
+    3. counts a queue-full promotion in ``run_drops`` (the untiered
+       analog: the run the narrow queue could not hold).
+
+    vmaps cleanly over a ``[K]`` lane axis.
+    """
+    p = int(prefix_len)
+    R, D = cfg.max_runs, cfg.dewey_depth
+    EH = cfg.slab_hot_entries
+    if not 0 < p <= D:
+        raise ValueError(
+            f"prefix_len={p} must be in 1..dewey_depth={D} (the promoted "
+            "version carries one digit per prefix stage)"
+        )
+    idents = [int(tables.ident[j]) for j in range(p)]
+    eval_pos = int(tables.consume_target[p - 1])
+    id_pos = idents[p - 1]
+    NS = max(tables.num_states, 1)
+
+    def _enc(x, dt):
+        if dt == "float32":
+            return int(np.float32(x).view(np.int32))
+        return int(np.int32(x))
+
+    inits_row = jnp.asarray(
+        [
+            _enc(x, d)
+            for x, d in zip(tables.state_inits, tables.state_dtypes)
+        ]
+        + [0] * (NS - tables.num_states)
+        or [0],
+        dtype=jnp.int32,
+    )
+
+    def promote(
+        state: EngineState, fire, offs, anchor_ts, sver
+    ) -> Tuple[EngineState, jnp.ndarray]:
+        i32 = jnp.int32
+        fire = jnp.asarray(fire)
+        cnt = jnp.sum(state.alive.astype(i32))
+        fit = fire & (cnt < R)
+
+        ver = jnp.zeros((D,), i32).at[0].set(jnp.asarray(sver, i32))
+        slab = state.slab
+        slab = slab_mod.put_first(
+            slab, jnp.int32(idents[0]), offs[..., 0], ver, jnp.int32(1),
+            enable=fit, hot_entries=EH,
+        )
+        for j in range(1, p):
+            slab = slab_mod.put(
+                slab, jnp.int32(idents[j]), offs[..., j],
+                jnp.int32(idents[j - 1]), offs[..., j - 1],
+                ver, jnp.int32(j + 1), enable=fit, hot_entries=EH,
+            )
+
+        row = cnt  # live runs are a contiguous prefix (queue compaction)
+        state = state._replace(
+            alive=put_at(state.alive, row, True, enable=fit),
+            id_pos=put_at(state.id_pos, row, jnp.int32(id_pos), enable=fit),
+            eval_pos=put_at(
+                state.eval_pos, row, jnp.int32(eval_pos), enable=fit
+            ),
+            ver=put_at(state.ver, row, ver[None, :], enable=fit),
+            vlen=put_at(state.vlen, row, jnp.int32(p), enable=fit),
+            event_off=put_at(
+                state.event_off, row, offs[..., p - 1], enable=fit
+            ),
+            start_ts=put_at(
+                state.start_ts, row, jnp.asarray(anchor_ts, i32), enable=fit
+            ),
+            branching=put_at(state.branching, row, False, enable=fit),
+            agg=put_at(state.agg, row, inits_row[None, :], enable=fit),
+            slab=slab,
+            run_drops=state.run_drops + jnp.where(fire & ~fit, 1, 0),
+        )
+        return state, jnp.where(fit, 1, 0).astype(i32)
+
+    return promote
+
+
+def stencil_step_output(tables, cfg: EngineConfig, prefix_len: int):
+    """Compile the pure-stencil tier's output synthesizer: prefix
+    completions rendered as the ``[K, T, R, W]`` :class:`StepOutput` grid
+    the untiered engine's extraction walk would emit — identity stages
+    final-first, offsets backward, one match (row 0) per completing
+    event.  Requires ``p <= max_walk`` (the tiering pass guarantees it:
+    a longer pattern would have been truncated by the walk bound, which
+    a stencil cannot reproduce)."""
+    p = int(prefix_len)
+    R, W = cfg.max_runs, cfg.max_walk
+    if p > W:
+        raise ValueError(
+            f"pure-stencil tier needs prefix_len={p} <= max_walk={W}"
+        )
+    rev_ident = jnp.asarray(
+        [int(tables.ident[j]) for j in range(p - 1, -1, -1)], jnp.int32
+    )
+
+    def synth(promo: PromoOutput) -> StepOutput:
+        i32 = jnp.int32
+        K, T = promo.fire.shape
+        fire = promo.fire
+        stage_rows = jnp.where(
+            fire[..., None], rev_ident[None, None, :], -1
+        )  # [K, T, p]
+        off_rows = jnp.where(fire[..., None], promo.offs[..., ::-1], -1)
+        pad = jnp.full((K, T, W - p), -1, i32)
+        stage = jnp.full((K, T, R, W), -1, i32)
+        off = jnp.full((K, T, R, W), -1, i32)
+        stage = stage.at[:, :, 0, :].set(
+            jnp.concatenate([stage_rows, pad], axis=-1)
+        )
+        off = off.at[:, :, 0, :].set(
+            jnp.concatenate([off_rows, pad], axis=-1)
+        )
+        count = jnp.zeros((K, T, R), i32).at[:, :, 0].set(
+            jnp.where(fire, p, 0)
+        )
+        return StepOutput(stage=stage, off=off, count=count)
+
+    return synth

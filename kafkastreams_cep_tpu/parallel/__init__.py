@@ -20,11 +20,13 @@ from kafkastreams_cep_tpu.parallel.stacked import (
     StackedBankMatcher,
     choose_bank,
 )
+from kafkastreams_cep_tpu.parallel.tiered import TieredBatchMatcher
 
 __all__ = [
     "BatchMatcher",
     "ShardedMatcher",
     "StackedBankMatcher",
+    "TieredBatchMatcher",
     "TimeShardedStencil",
     "choose_bank",
     "key_mesh",

@@ -488,10 +488,15 @@ class BatchMatcher:
         """Engine-level telemetry of ``state`` in one dict: summed drop and
         hot-tier counters plus the per-lane breakdown (and the per-stage
         attribution roll-up when enabled)."""
+        from kafkastreams_cep_tpu.engine.matcher import TIER_COUNTER_NAMES
+
         out: Dict[str, object] = {}
         out.update(self.counters(state))
         out.update(self.hot_counters(state))
         out.update(self.walk_counters(state))
+        # Untiered: the tier counters are structural zeros so dashboards
+        # see one schema (TieredBatchMatcher overrides with real values).
+        out.update({n: 0 for n in TIER_COUNTER_NAMES})
         out["per_lane"] = self.per_lane_counters(state)
         per_stage = self.stage_counters(state)
         if per_stage:

@@ -298,7 +298,13 @@ class ShardedMatcher:
         registries merged: the summed view rides the one-``psum`` ``stats``
         collective (each shard's counter block is its local registry; the
         psum IS the merge), the per-lane breakdown a host gather."""
+        from kafkastreams_cep_tpu.engine.matcher import TIER_COUNTER_NAMES
+
         out: Dict[str, object] = dict(self.stats(state))
+        # Tiering is single-chip today (the hybrid scan host-gates the NFA
+        # dispatch, which shard_map cannot): the tier counters ride the
+        # merged snapshot as structural zeros so the fleet schema is one.
+        out.update({n: 0 for n in TIER_COUNTER_NAMES})
         out["per_lane"] = self.per_lane_counters(state)
         per_stage = self.stage_counters(state)
         if per_stage:

@@ -211,10 +211,15 @@ class StackedBankMatcher:
         """Bank-wide engine telemetry: the per-member registries merged
         (summed drop + hot counters) beside the ``per_pattern`` breakdown
         that attributes them to individual queries."""
+        from kafkastreams_cep_tpu.engine.matcher import TIER_COUNTER_NAMES
+
         out: Dict[str, object] = {}
         out.update(self.counters(state))
         out.update(self.hot_counters(state))
         out.update(self.walk_counters(state))
+        # Stacked banks run whole-NFA (same-shape stacking is the point);
+        # tier counters are structural zeros for schema uniformity.
+        out.update({n: 0 for n in TIER_COUNTER_NAMES})
         out["per_pattern"] = self.per_query_counters(state)
         per_stage = self.stage_counters(state)
         if per_stage:

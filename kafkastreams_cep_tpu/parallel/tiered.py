@@ -1,0 +1,305 @@
+"""Tiered single-chip matcher: stencil prefix tier + NFA suffix tier.
+
+Drop-in for :class:`~kafkastreams_cep_tpu.parallel.batch.BatchMatcher`
+(same scan/sweep/drain/counters surface, ``CEPProcessor`` selects it when
+``EngineConfig.tiering`` is set) that executes the compiler tiering plan
+(``compiler/tiering.py``):
+
+* ``nfa``     — no usable prefix: pure delegation to the inner
+  :class:`BatchMatcher`, state still wrapped in :class:`TieredState` so
+  every config compiles to one state shape.
+* ``stencil`` — the whole pattern is a strict sequence: the prefix tier
+  IS the matcher; completions are rendered as the engine's ``StepOutput``
+  grid (``engine/tiered.py: stencil_step_output``) and the NFA engine is
+  never dispatched (its ``step_seq`` still ticks, keeping drain/handle
+  ordering invariants intact).
+* ``hybrid``  — the stencil screens the whole ``[K, T]`` batch first
+  (fully parallel over keys *and* time), then the NFA tier scans the
+  batch with a promotion step fused after every engine step
+  (``engine/tiered.py: build_promote``).  When the stencil reports no
+  completions **and** no suffix run is alive anywhere, the NFA dispatch
+  is skipped outright — on screened (production-monitoring-shaped)
+  traffic most batches never pay a single NFA step.  The skip is exact:
+  a stepped empty queue changes nothing but ``step_seq``, which the skip
+  path advances by ``T`` in one op.
+
+The gating check costs one scalar ``device_get`` per ``scan`` call (the
+stencil output must be inspected on host to elide the NFA dispatch);
+pipelined processors therefore lose some dispatch/decode overlap under
+tiering — throughput on screened workloads gains far more than the sync
+costs (bench ``CEP_BENCH_TIER``).
+
+Parity: matches, emission order, and loss counters are bit-identical to
+the untiered engine on loss-free workloads across the jnp and Pallas
+walk-kernel paths (tests/test_tiering.py).  Under ``CEP_SCAN_KERNEL``
+the *hybrid* suffix scan falls back to the per-step kernel path (the
+whole-scan Pallas program cannot take per-step promotion inputs); the
+untiered scan-kernel output is bit-identical to the per-step path, so
+tiered-vs-untiered parity is unaffected.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kafkastreams_cep_tpu.compiler.tables import TransitionTables, lower
+from kafkastreams_cep_tpu.compiler.tiering import (
+    TIER_HYBRID,
+    TIER_NFA,
+    TIER_STENCIL,
+    TieringPlan,
+    apply_lazy_order,
+    plan_tiering,
+)
+from kafkastreams_cep_tpu.engine.matcher import (
+    TIER_COUNTER_NAMES,
+    EngineConfig,
+    EngineState,
+    EventBatch,
+    StepOutput,
+)
+from kafkastreams_cep_tpu.engine.stencil import PrefixCarry, StencilPrefix
+from kafkastreams_cep_tpu.engine.tiered import (
+    TieredState,
+    build_promote,
+    seedless_init,
+    stencil_step_output,
+)
+from kafkastreams_cep_tpu.parallel.batch import (
+    BatchMatcher,
+    broadcast_state,
+    kernel_lane_step,
+    lane_step,
+)
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.tiered")
+
+
+class TieredBatchMatcher:
+    """``K`` lanes matched under a compiler tiering plan (one chip).
+
+    ``profile`` is an optional measured ``per_stage`` snapshot
+    (``metrics_snapshot()["per_stage"]`` from a ``stage_attribution``
+    run) consumed by the lazy-chain predicate ordering; without it the
+    static cost model orders the conjuncts.  ``reorder=False`` skips the
+    ordering pass entirely (differential baseline).
+    """
+
+    def __init__(
+        self,
+        pattern,
+        num_lanes: int,
+        config: Optional[EngineConfig] = None,
+        profile: Optional[Dict] = None,
+        reorder: bool = True,
+    ):
+        tables = (
+            pattern
+            if isinstance(pattern, TransitionTables)
+            else lower(pattern)
+        )
+        config = config or EngineConfig()
+        if reorder:
+            tables, self.lazy_order = apply_lazy_order(tables, profile)
+        else:
+            self.lazy_order = {}
+        self.plan: TieringPlan = plan_tiering(tables, config, profile)
+        self.tables = tables
+        self.num_lanes = int(num_lanes)
+        self.inner = BatchMatcher(tables, num_lanes, config)
+        self.matcher = self.inner.matcher
+        self.uses_walk_kernel = self.inner.uses_walk_kernel
+        self.uses_scan_kernel = False  # the tiered scan is step-driven
+        logger.info(
+            "tiered matcher: %s (%s), %d lanes",
+            self.plan.tier, self.plan.reason, self.num_lanes,
+        )
+        # Host-side dispatch accounting: how often the NFA tier actually
+        # ran (the skip-gate's measurable effect; bench CEP_BENCH_TIER).
+        self.scan_calls = 0
+        self.nfa_dispatches = 0
+        p = self.plan.prefix_len
+        if self.plan.tier == TIER_NFA:
+            self._prefix = None
+        else:
+            self._prefix = StencilPrefix(tables, num_lanes, p)
+            self._promote = build_promote(tables, config, p)
+            if self.plan.tier == TIER_STENCIL:
+                self._synth = jax.jit(
+                    stencil_step_output(tables, config, p)
+                )
+            if self.inner.uses_scan_kernel:
+                # The whole-scan Pallas program has no per-step promotion
+                # inputs; the per-step (kernel or jnp) path is bit-
+                # identical, so the fallback costs nothing but the fusion.
+                logger.warning(
+                    "CEP_SCAN_KERNEL requested but the hybrid tier runs "
+                    "the per-step path (promotions are per-step inputs)"
+                )
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def names(self) -> List[str]:
+        return self.inner.names
+
+    def _empty_carry(self) -> PrefixCarry:
+        K = self.num_lanes
+        i32 = jnp.int32
+        z = jnp.zeros((K,), i32)
+        return PrefixCarry(
+            bools=jnp.zeros((K, 0, 0), bool),
+            offs=jnp.zeros((K, 0), i32),
+            ts=jnp.zeros((K, 0), i32),
+            sver=jnp.zeros((K, 0), i32),
+            cnt=z, screened=z, fires=z, promotions=z,
+        )
+
+    def init_state(self) -> TieredState:
+        if self.plan.tier == TIER_NFA:
+            return TieredState(
+                engine=self.inner.init_state(), carry=self._empty_carry()
+            )
+        # The begin stage lives on the stencil tier: the NFA queue starts
+        # empty and only promotions populate it.
+        eng = broadcast_state(
+            seedless_init(self.matcher._init_fn), self.num_lanes
+        )
+        return TieredState(engine=eng, carry=self._prefix.init_carry())
+
+    # -- the scan ------------------------------------------------------------
+
+    @functools.cached_property
+    def _bump_jit(self):
+        """Advance ``step_seq`` by T without stepping: the exact effect a
+        full scan of an empty, promotion-free queue would have had."""
+        return jax.jit(
+            lambda eng, t: eng._replace(step_seq=eng.step_seq + t)
+        )
+
+    @functools.cached_property
+    def _gate_jit(self):
+        return jax.jit(lambda alive, fire: jnp.any(alive) | jnp.any(fire))
+
+    @functools.cached_property
+    def _hybrid_scan_jit(self):
+        if self.inner.uses_walk_kernel:
+            base_step = kernel_lane_step(
+                self.matcher._phases, self.inner._kernel_interpret
+            )
+        else:
+            base_step = lane_step(self.matcher._step_fn)
+        promote_b = jax.vmap(self._promote)
+
+        def scan(eng: EngineState, events: EventBatch, promo):
+            swap = lambda x: jnp.swapaxes(x, 0, 1)
+            ev_t = jax.tree_util.tree_map(swap, events)
+            pr_t = jax.tree_util.tree_map(swap, promo)
+
+            def body(s, x):
+                ev, pr = x
+                # Step first, then promote: the prefix completes *at*
+                # event t, and the promoted run first evaluates at t+1 —
+                # exactly the untiered run's schedule.
+                s, out = base_step(s, ev)
+                s, n = promote_b(s, pr.fire, pr.offs, pr.anchor_ts, pr.sver)
+                return s, (out, n)
+
+            eng, (outs, ns) = jax.lax.scan(body, eng, (ev_t, pr_t))
+            outs = jax.tree_util.tree_map(swap, outs)
+            return eng, outs, jnp.sum(ns, axis=0)  # ns: [T, K] -> [K]
+
+        return jax.jit(scan)
+
+    def _zero_out(self, T: int) -> StepOutput:
+        cfg = self.matcher.config
+        K, R, W = self.num_lanes, cfg.max_runs, cfg.max_walk
+        i32 = jnp.int32
+        return StepOutput(
+            stage=jnp.full((K, T, R, W), -1, i32),
+            off=jnp.full((K, T, R, W), -1, i32),
+            count=jnp.zeros((K, T, R), i32),
+        )
+
+    def scan(self, state: TieredState, events: EventBatch):
+        """One ``[K, T]`` batch through the tier plan.  Same output
+        contract as :meth:`BatchMatcher.scan`; host-gated, so not itself
+        jittable (callers that need a pure jitted scan use the untiered
+        matcher)."""
+        T = int(events.ts.shape[1])
+        self.scan_calls += 1
+        if self.plan.tier == TIER_NFA:
+            self.nfa_dispatches += 1
+            eng, out = self.inner.scan(state.engine, events)
+            return TieredState(eng, state.carry), out
+        carry, promo = self._prefix.scan(state.carry, events)
+        if self.plan.tier == TIER_STENCIL:
+            out = self._synth(promo)
+            eng = self._bump_jit(state.engine, jnp.int32(T))
+            return TieredState(eng, carry), out
+        # Hybrid: skip the NFA dispatch outright when nothing can happen
+        # there — no live suffix run and no promotion this batch.  One
+        # scalar sync; the skip is exact (see module docstring).
+        needed = bool(
+            jax.device_get(
+                self._gate_jit(state.engine.alive, promo.fire)
+            )
+        )
+        if not needed:
+            eng = self._bump_jit(state.engine, jnp.int32(T))
+            return TieredState(eng, carry), self._zero_out(T)
+        self.nfa_dispatches += 1
+        eng, out, promoted = self._hybrid_scan_jit(
+            state.engine, events, promo
+        )
+        carry = carry._replace(promotions=carry.promotions + promoted)
+        return TieredState(eng, carry), out
+
+    # -- maintenance / drains ------------------------------------------------
+
+    def sweep(self, state: TieredState) -> TieredState:
+        """Engine-tier maintenance sweep; the stencil carry holds no slab
+        references (partial prefixes own no entries) so it rides along
+        untouched."""
+        return state._replace(engine=self.inner.sweep(state.engine))
+
+    def drain(self, state: TieredState):
+        eng, out = self.inner.drain(state.engine)
+        return state._replace(engine=eng), out
+
+    # -- telemetry -----------------------------------------------------------
+
+    def counters(self, state: TieredState) -> Dict[str, int]:
+        return self.inner.counters(state.engine)
+
+    def hot_counters(self, state: TieredState) -> Dict[str, int]:
+        return self.inner.hot_counters(state.engine)
+
+    def walk_counters(self, state: TieredState) -> Dict[str, int]:
+        return self.inner.walk_counters(state.engine)
+
+    def per_lane_counters(self, state: TieredState) -> Dict[str, list]:
+        return self.inner.per_lane_counters(state.engine)
+
+    def stage_counters(self, state: TieredState):
+        return self.inner.stage_counters(state.engine)
+
+    def tier_counters(self, state: TieredState) -> Dict[str, int]:
+        """Lane-summed tier telemetry in ``TIER_COUNTER_NAMES`` order:
+        events screened by the prefix tier, prefix completions, and runs
+        promoted into the NFA tier."""
+        c = state.carry
+        vals = jax.device_get(
+            (jnp.sum(c.screened), jnp.sum(c.fires), jnp.sum(c.promotions))
+        )
+        return {n: int(v) for n, v in zip(TIER_COUNTER_NAMES, vals)}
+
+    def metrics_snapshot(self, state: TieredState) -> Dict[str, object]:
+        out = self.inner.metrics_snapshot(state.engine)
+        out.update(self.tier_counters(state))
+        return out

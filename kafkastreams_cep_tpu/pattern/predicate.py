@@ -21,9 +21,18 @@ MatcherFn = Callable[[Any, Any, Any, Any], Any]
 
 
 class Matcher:
-    """A named, composable guard over ``(key, value, timestamp, states)``."""
+    """A named, composable guard over ``(key, value, timestamp, states)``.
 
-    __slots__ = ("fn", "label")
+    Combinator structure is recorded (``op``/``parts``) so compile-time
+    passes can see through it: ``and_`` chains are commuting conjunct
+    lists the tiering pass (``compiler/tiering.py``) may reorder by
+    selectivity/cost without changing semantics.  ``cost_hint`` and
+    ``selectivity_hint`` are optional user annotations consumed by that
+    pass's static cost model (see :func:`hint`); neither affects what the
+    matcher computes.
+    """
+
+    __slots__ = ("fn", "label", "op", "parts", "cost_hint", "selectivity_hint")
 
     def __init__(self, fn: MatcherFn, label: Optional[str] = None):
         if isinstance(fn, Matcher):
@@ -32,6 +41,10 @@ class Matcher:
             raise TypeError(f"matcher must be callable, got {type(fn)!r}")
         self.fn = fn
         self.label = label or getattr(fn, "__name__", "matcher")
+        self.op: Optional[str] = None  # "and" | "or" | "not" for combinators
+        self.parts: tuple = ()
+        self.cost_hint: Optional[float] = None
+        self.selectivity_hint: Optional[float] = None
 
     def __call__(self, key, value, timestamp, states):
         return self.fn(key, value, timestamp, states)
@@ -67,7 +80,9 @@ def not_(matcher) -> Matcher:
         result = _normalize(m(key, value, timestamp, states))
         return (not result) if isinstance(result, bool) else ~result
 
-    return Matcher(fn, label=f"not({m.label})")
+    out = Matcher(fn, label=f"not({m.label})")
+    out.op, out.parts = "not", (m,)
+    return out
 
 
 def and_(left, right) -> Matcher:
@@ -80,7 +95,9 @@ def and_(left, right) -> Matcher:
             return lv and rv
         return lv & rv
 
-    return Matcher(fn, label=f"and({l.label},{r.label})")
+    out = Matcher(fn, label=f"and({l.label},{r.label})")
+    out.op, out.parts = "and", (l, r)
+    return out
 
 
 def or_(left, right) -> Matcher:
@@ -93,7 +110,24 @@ def or_(left, right) -> Matcher:
             return lv or rv
         return lv | rv
 
-    return Matcher(fn, label=f"or({l.label},{r.label})")
+    out = Matcher(fn, label=f"or({l.label},{r.label})")
+    out.op, out.parts = "or", (l, r)
+    return out
+
+
+def hint(matcher, cost: Optional[float] = None,
+         selectivity: Optional[float] = None) -> Matcher:
+    """Annotate a matcher with a relative evaluation cost and/or an
+    expected accept fraction (0..1).  Pure metadata for the lazy-chain
+    ordering pass (``compiler/tiering.py: apply_lazy_order``): cheap,
+    selective conjuncts are ordered ahead of expensive ones.  Returns the
+    (wrapped) matcher itself."""
+    m = _wrap(matcher)
+    if cost is not None:
+        m.cost_hint = float(cost)
+    if selectivity is not None:
+        m.selectivity_hint = float(selectivity)
+    return m
 
 
 def true_() -> Matcher:

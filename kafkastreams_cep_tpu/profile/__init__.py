@@ -424,7 +424,24 @@ def run_selectivity(args) -> Dict[str, Any]:
     )
     overhead = (best_on - best_off) / best_off * 100.0
 
+    # Per-query compiler-tiering tag (ISSUE 7): which tier this query
+    # would execute on, plus the lazy-chain conjunct ordering the pass
+    # derives from THIS run's measured per-stage selectivity.
+    from kafkastreams_cep_tpu.compiler.tables import lower
+    from kafkastreams_cep_tpu.compiler.tiering import (
+        apply_lazy_order,
+        plan_tiering,
+    )
+
     per_stage = on_b.stage_counters(state)
+    tables = lower(pattern)
+    _, lazy_report = apply_lazy_order(tables, per_stage)
+    tier_tag = {
+        "stock": {
+            **plan_tiering(tables, base).describe(),
+            "lazy_order": lazy_report,
+        }
+    }
     arrays = per_lane_counter_arrays(state)
     hops = (
         arrays["walk_hops"] + arrays["extract_hops"] + arrays["drain_hops"]
@@ -460,6 +477,9 @@ def run_selectivity(args) -> Dict[str, Any]:
         "overhead_pct": round(overhead, 2),
         "per_stage": per_stage,
         "per_key": per_key,
+        # tier=stencil|hybrid|nfa per query + the lazy-chain conjunct
+        # order derived from the measured selectivity above.
+        "tier": tier_tag,
         "compile_s": {"off": round(comp_off, 2), "on": round(comp_on, 2)},
     }
 

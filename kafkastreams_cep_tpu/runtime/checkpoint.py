@@ -258,8 +258,13 @@ def restore_processor(
     proc.state = proc.place(_unflatten_state(proc.state, ckpt["arrays"]))
     # The drained-handle ordering base is derivable from device state:
     # step_seq is the per-lane step counter (identical across lanes — all
-    # lanes step together), and a restore resumes exactly at it.
-    proc._step_base = int(np.max(np.asarray(ckpt["arrays"]["step_seq"])))
+    # lanes step together), and a restore resumes exactly at it.  Tiered
+    # processors nest the engine state (engine/tiered.py: TieredState),
+    # so the flattened array name carries the ``engine/`` prefix.
+    step_seq = ckpt["arrays"].get(
+        "step_seq", ckpt["arrays"].get("engine/step_seq")
+    )
+    proc._step_base = int(np.max(np.asarray(step_seq)))
     proc._lane_of = dict(header["lane_of"])
     proc._key_of = {v: k for k, v in proc._lane_of.items()}
     proc._next_offset = np.asarray(header["next_offset"]).copy()

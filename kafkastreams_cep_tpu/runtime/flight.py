@@ -109,7 +109,8 @@ class FlightRecorder:
         guard = getattr(processor, "_guard", None)
         if guard is not None:
             flat.update(guard.loss_counters())
-        state = processor.state
+        # Tiered processors wrap the engine state (engine/tiered.py).
+        state = getattr(processor.state, "engine", processor.state)
         # Two tiny device reductions; jax.device_get syncs them together.
         slab_live, ring_pending = (
             int(v)

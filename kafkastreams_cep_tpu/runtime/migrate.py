@@ -91,6 +91,10 @@ _SEMANTIC_FLAGS = (
     # arrays ([S] vs [0]) — a live embedding across the flip does not
     # exist, so it rides the no-change list.
     "stage_attribution",
+    # Tiering shapes the state itself (TieredState wraps the engine state
+    # with the stencil prefix carry, engine/tiered.py): a flip mid-stream
+    # would orphan either the carry or the seed run.
+    "tiering",
 )
 
 
@@ -132,7 +136,21 @@ def widen_state(
 ) -> EngineState:
     """Embed ``state`` (host or device arrays, any leading batch axes)
     into the shapes of ``new``.  Returns host numpy arrays; callers
-    re-place onto the device (``CEPProcessor.place``)."""
+    re-place onto the device (``CEPProcessor.place``).
+
+    A tiered state (``engine/tiered.py: TieredState``) widens by widening
+    its engine half; the stencil prefix carry is shaped by the *pattern*
+    (prefix length), not by any capacity knob, so it copies verbatim —
+    a live partial prefix survives the migration bit-for-bit.
+    """
+    inner = getattr(state, "engine", None)
+    if inner is not None:
+        import jax as _jax
+
+        return state._replace(
+            engine=widen_state(inner, old, new),
+            carry=_jax.tree_util.tree_map(np.asarray, state.carry),
+        )
     check_widens(old, new)
     g = lambda x: np.asarray(x)  # device_get + concrete dtype
     R2, E2, MP2, D2 = (
@@ -217,7 +235,19 @@ def canonical_state(state: EngineState) -> EngineState:
     residue).  Two states are behaviorally identical iff their canonical
     projections are bit-equal; the migration parity and chaos-oracle
     suites compare through this.
+
+    Tiered states project their engine half; the stencil carry is already
+    canonical (the trailing window is rewritten wholesale every scan, so
+    it holds no implementation-dependent residue).
     """
+    inner = getattr(state, "engine", None)
+    if inner is not None:
+        import jax as _jax
+
+        return state._replace(
+            engine=canonical_state(inner),
+            carry=_jax.tree_util.tree_map(np.asarray, state.carry),
+        )
     g = lambda x: np.asarray(x)
     alive = g(state.alive)
     slab = state.slab

@@ -155,10 +155,25 @@ class CEPProcessor:
         # checkpoints gather to host arrays (mesh-agnostic, so a restore
         # may re-place onto a different mesh — the rebalance analog).
         self.mesh = mesh
+        tiering = config is not None and getattr(config, "tiering", False)
         if mesh is not None:
             from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher
 
+            if tiering:
+                # Tiering host-gates the NFA dispatch per batch, which
+                # shard_map cannot express today; refusing beats silently
+                # restoring a tiered checkpoint into an untiered shape.
+                raise ValueError(
+                    "EngineConfig.tiering is single-chip: construct the "
+                    "processor without a mesh (or without tiering)"
+                )
             self.batch = ShardedMatcher(pattern, num_lanes, mesh, config)
+        elif tiering:
+            from kafkastreams_cep_tpu.parallel.tiered import (
+                TieredBatchMatcher,
+            )
+
+            self.batch = TieredBatchMatcher(pattern, num_lanes, config)
         else:
             self.batch = BatchMatcher(pattern, num_lanes, config)
         self.topic = topic
@@ -1151,10 +1166,13 @@ class CEPProcessor:
         only needs events still present in a lane's slab or pointed at by a
         live run, so everything else is released here after each batch.
         """
-        slab_stage = np.asarray(jax.device_get(self.state.slab.stage))  # [K, E]
-        slab_off = np.asarray(jax.device_get(self.state.slab.off))
-        run_alive = np.asarray(jax.device_get(self.state.alive))  # [K, R]
-        run_off = np.asarray(jax.device_get(self.state.event_off))
+        # Tiered processors wrap the engine state (engine/tiered.py);
+        # liveness lives in the engine half either way.
+        eng = getattr(self.state, "engine", self.state)
+        slab_stage = np.asarray(jax.device_get(eng.slab.stage))  # [K, E]
+        slab_off = np.asarray(jax.device_get(eng.slab.off))
+        run_alive = np.asarray(jax.device_get(eng.alive))  # [K, R]
+        run_off = np.asarray(jax.device_get(eng.event_off))
         for k in range(self.num_lanes):
             live = set(slab_off[k][slab_stage[k] >= 0].tolist())
             live.update(run_off[k][run_alive[k]].tolist())
@@ -1205,6 +1223,17 @@ class CEPProcessor:
         by walker class — the reduce-width perf model's observables)."""
         return self.batch.walk_counters(self.state)
 
+    def tier_counters(self) -> Dict[str, int]:
+        """Compiler-tiering telemetry (events screened by the stencil
+        prefix tier / prefix completions / NFA promotions); structural
+        zeros on untiered processors."""
+        from kafkastreams_cep_tpu.engine.matcher import TIER_COUNTER_NAMES
+
+        fn = getattr(self.batch, "tier_counters", None)
+        if fn is None:
+            return {n: 0 for n in TIER_COUNTER_NAMES}
+        return fn(self.state)
+
     def metrics_snapshot(self, per_lane: bool = True) -> Dict[str, Any]:
         """Runtime metrics + engine counters + attribution in one dict.
 
@@ -1221,6 +1250,8 @@ class CEPProcessor:
         hot = self.hot_counters()
         snap.update(hot)
         snap.update(self.walk_counters())
+        tier = self.tier_counters()
+        snap.update(tier)
         snap["watermark"] = self._watermark
         snap["event_time_lag_ms"] = (
             int(time.time() * 1000) - self._watermark
@@ -1238,10 +1269,17 @@ class CEPProcessor:
             self.name: {
                 **self.counters(),
                 **hot,
+                **tier,  # labeled cep_prefix_*/cep_tier_* series per query
                 "records_in": self.metrics.records_in,
                 "matches_out": self.metrics.matches_out,
             }
         }
+        plan = getattr(self.batch, "plan", None)
+        if plan is not None:
+            # The compiler tiering decision (per-query ``tier=`` tag of
+            # the profiler CLI; strings are skipped by the Prometheus
+            # renderer, the counters above are the scrapeable series).
+            snap["tier_plan"] = plan.describe()
         per_stage = self.batch.stage_counters(self.state)
         if per_stage:
             # Per-stage selectivity & cost attribution

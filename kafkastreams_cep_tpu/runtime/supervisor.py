@@ -97,14 +97,16 @@ def check_health(processor: CEPProcessor) -> HealthReport:
     errors = []
     # Fold state is typed-encoded int32 (float32 states as bit patterns,
     # engine/matcher.py); only float-typed columns can hold NaN.
-    agg = np.asarray(processor.state.agg)
+    # Tiered processors wrap the engine state (engine/tiered.py).
+    eng = getattr(processor.state, "engine", processor.state)
+    agg = np.asarray(eng.agg)
     dtypes = processor.batch.matcher.tables.state_dtypes
     flt = [i for i, d in enumerate(dtypes) if d == "float32"]
     if flt and np.isnan(
         np.ascontiguousarray(agg[..., flt]).view(np.float32)
     ).any():
         errors.append("NaN in fold-aggregate state")
-    refs = np.asarray(processor.state.slab.refs)
+    refs = np.asarray(eng.slab.refs)
     if (refs < 0).any():
         errors.append("negative slab refcount")
     return HealthReport(

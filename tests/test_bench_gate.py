@@ -122,3 +122,28 @@ def test_profiler_cli_selectivity_smoke():
                 "stage_rejects", "stage_walk_hops", "selectivity"):
         assert key in row
     assert "top" in doc["per_key"]
+
+
+def test_gate_guards_tier_parity_flags():
+    """From BENCH_r06 on, the nested ``tier`` block's match-parity and
+    counters-zero flags flatten into guarded ``tier_*`` flags: a later
+    round may not regress them (ISSUE 7 satellite)."""
+    r06 = bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r06.json"))
+    m = bench_gate.extract_metrics(r06)
+    assert m["tier_match_parity"] is True
+    assert m["tier_counters_zero"] is True
+    bad = json.loads(json.dumps(r06))
+    bad["parsed"]["tier"]["match_parity"] = False
+    ok, report = bench_gate.gate(bad, [r06])
+    assert not ok
+    assert any(
+        c["metric"] == "tier_match_parity" and not c["ok"]
+        for c in report["checks"]
+    )
+    # Earlier rounds without a tier block are simply unguarded, so the
+    # historical trajectory still replays clean (covered above).
+    assert "tier_match_parity" not in (
+        bench_gate.extract_metrics(
+            bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r05.json"))
+        ) or {}
+    )
