@@ -37,7 +37,10 @@ under the telemetry Reporter and print the per-phase p50/p99 block;
 ``CEP_BENCH_METRICS_{K,T,BATCHES}`` size it), ``CEP_BENCH_TIER``
 (compiler-tiering A/B: untiered vs tiered on a strict-prefix-dominated
 match-sparse trace, default 1; ``CEP_BENCH_TIER_{K,T,CHUNK,REPS}`` size
-it), ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
+it), ``CEP_BENCH_SHARDF`` (shard fault tolerance probes: kill-one-shard
+evacuation latency + degraded throughput, and the hot-key rebalance
+loss contract, default 1 when >= 2 devices; ``CEP_BENCH_SHARDF_{K,B}``
+size them), ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -1361,6 +1364,173 @@ def bench_resilience():
     return out
 
 
+def bench_shard_fault():
+    """``CEP_BENCH_SHARDF``: shard fault tolerance probes (ISSUE 13).
+
+    Two supervisor-level scenarios on a 2-device sub-mesh, each compared
+    for match parity against a fault-free single-device run of the same
+    stream:
+
+    * **kill one shard** — a ``ShardLost`` out of the meshed dispatch
+      mid-stream.  ``evacuate_s`` is the wall-clock of the batch that
+      absorbs the loss (rollback + journal replay + re-pin onto the
+      surviving sub-mesh + the re-processed batch); ``post_evac_evps``
+      is the degraded throughput afterwards.  ``evac_parity`` requires
+      exactly-once emission vs the fault-free run.
+    * **hot-key rebalance** — a skewed stream (two keys take ~all the
+      work, both on shard 0) trips the heavy-hitter policy at a
+      checkpoint boundary.  ``rebalance_lossfree`` is the loss
+      contract: at least one move happened, zero dropped or duplicated
+      matches, capacity counters clean.
+
+    Both flags are guarded by bench_gate.py once recorded.  Returns
+    ``{}`` (and the whole block is absent from the JSON) on a
+    single-device host.
+    """
+    import shutil
+    import tempfile
+
+    from kafkastreams_cep_tpu.parallel import ShardLost, key_mesh
+    from kafkastreams_cep_tpu.runtime import (
+        CEPProcessor,
+        Record,
+        ShardPolicy,
+        Supervisor,
+    )
+    from kafkastreams_cep_tpu.utils import failpoints as fp
+
+    if jax.device_count() < 2:
+        log("shard-fault: skipped (needs >= 2 devices)")
+        return {}
+
+    K = int(os.environ.get("CEP_BENCH_SHARDF_K", "16"))
+    batch_records = int(os.environ.get("CEP_BENCH_SHARDF_B", "256"))
+    n_batches = 6
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    rng = np.random.default_rng(7)
+
+    def mk_batches(n, offs, skew=False):
+        # Explicit per-key offsets: rollback + journal replay must dedup
+        # re-presented records, and auto offsets would double-emit.
+        # ``skew``: batch 0 touches every lane round-robin (pinning key i
+        # to lane i, so keys 0/1 share shard 0), later batches hit only
+        # keys 0 and 1.
+        out_b = []
+        for i in range(n):
+            recs = []
+            for j in range(batch_records):
+                if skew:
+                    k = int(rng.integers(2)) if i else (j % K)
+                else:
+                    k = int(rng.integers(K))
+                vol = 1100 if rng.random() < 0.01 else int(
+                    rng.integers(700, 1000)
+                )
+                recs.append(Record(
+                    k,
+                    {"price": int(rng.integers(90, 131)), "volume": vol},
+                    1000 + batch_records * i + j,
+                    offset=offs.setdefault(k, 0),
+                ))
+                offs[k] += 1
+            out_b.append(recs)
+        return out_b
+
+    def canon(matches):
+        return sorted(
+            (k, tuple(sorted(
+                (stage, tuple(e.offset for e in evs))
+                for stage, evs in seq.as_map().items()
+            )))
+            for k, seq in matches
+        )
+
+    def oracle(batches):
+        proc = CEPProcessor(
+            stock_demo.stock_pattern(), K, cfg, gc_interval=0
+        )
+        out_m = []
+        for b in batches:
+            out_m += proc.process(b)
+        return canon(out_m + proc.flush())
+
+    out = {}
+    workdir = tempfile.mkdtemp(prefix="cep_bench_shardf_")
+    try:
+        batches = mk_batches(n_batches, {})
+        sup = Supervisor(
+            stock_demo.stock_pattern(), K, cfg,
+            checkpoint_path=os.path.join(workdir, "s.ckpt"),
+            journal_path=os.path.join(workdir, "s.jrnl"),
+            checkpoint_every=2, gc_interval=0,
+            mesh=key_mesh(jax.devices()[:2]),
+        )
+        got = []
+        for b in batches[:2]:
+            got += sup.process(b)
+        t0 = time.perf_counter()  # host-timed (evacuation + re-process)
+        with fp.FAILPOINTS.session(
+            {"shard.dispatch": [0]},
+            exc=lambda: ShardLost("bench-injected device loss", shard=1),
+        ):
+            got += sup.process(batches[2])
+        out["evacuate_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()  # host-timed (degraded throughput)
+        for b in batches[3:]:
+            got += sup.process(b)
+        post_s = time.perf_counter() - t0
+        got += sup.processor.flush()
+        out["post_evac_evps"] = round(
+            batch_records * (n_batches - 3) / post_s, 1
+        )
+        out["evac_parity"] = bool(
+            sup.evacuations == 1 and canon(got) == oracle(batches)
+        )
+
+        skew = mk_batches(n_batches, {}, skew=True)
+        sup2 = Supervisor(
+            stock_demo.stock_pattern(), K, cfg,
+            checkpoint_path=os.path.join(workdir, "r.ckpt"),
+            journal_path=os.path.join(workdir, "r.jrnl"),
+            checkpoint_every=2, gc_interval=0,
+            mesh=key_mesh(jax.devices()[:2]),
+            shard_policy=ShardPolicy(
+                rebalance_skew=1.2, rebalance_min_hops=8,
+                rebalance_streak=1, rebalance_cooldown=0,
+            ),
+        )
+        got2 = []
+        for b in skew:
+            got2 += sup2.process(b)
+        got2 += sup2.processor.flush()
+        out["rebalance_moves"] = int(sup2.rebalances)
+        out["rebalance_lanes_moved"] = int(sup2.lanes_moved)
+        ph = sup2.metrics_snapshot(per_lane=False)["phases"].get(
+            "rebalance"
+        )
+        if ph and ph.get("count"):
+            out["rebalance_s"] = round(float(ph["p50"]), 3)
+        out["rebalance_lossfree"] = bool(
+            sup2.rebalances >= 1
+            and not any(sup2.processor.counters().values())
+            and canon(got2) == oracle(skew)
+        )
+        log(
+            f"shard-fault (K={K}, {batch_records}-record batches): "
+            f"evacuate {out['evacuate_s']}s (parity="
+            f"{out['evac_parity']}), post-evacuation "
+            f"{out['post_evac_evps']} events/s, rebalance moves="
+            f"{out['rebalance_moves']} lanes={out['rebalance_lanes_moved']}"
+            f" (lossfree={out['rebalance_lossfree']})"
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return out
+
+
 def bench_ooo():
     """``CEP_BENCH_OOO``: graceful-ingestion A/B (ISSUE 5).
 
@@ -1524,6 +1694,18 @@ def main():
     proc_phases = {}
     ooo = {}
     tier = {}
+
+    def _shard_fault_block():
+        # Nested under ``resilience`` so the JSON groups every
+        # fault-path number; absent entirely when skipped (single
+        # device or CEP_BENCH_SHARDF=0), which bench_gate treats as a
+        # missing metric, not a regression.
+        if os.environ.get("CEP_BENCH_SHARDF", "1") != "1":
+            log("shard-fault: skipped (CEP_BENCH_SHARDF=0)")
+            return {}
+        shard = bench_shard_fault()
+        return {"shard": shard} if shard else {}
+
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
         budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "1200"))
         extras = [
@@ -1546,6 +1728,10 @@ def main():
             (
                 "resilience",
                 lambda: resilience.update(bench_resilience()),
+            ),
+            (
+                "shard-fault",
+                lambda: resilience.update(_shard_fault_block()),
             ),
             (
                 "processor",

@@ -14,9 +14,12 @@ than the trajectory's best on any guarded metric:
   (baseline_spread + new_spread) / 100)`` — the reported rep-to-rep
   spreads are the run's own noise estimate, so a noisy environment
   widens its own tolerance instead of flapping the gate.
-* **Loss metrics** (must not degrade): ``lossfree_counters_zero`` and
-  ``lossfree_oracle_parity`` may not go true→false; ``recall_sampled``
-  may not drop by more than the same relative tolerance.
+* **Loss metrics** (must not degrade): the boolean flags
+  (``lossfree_counters_zero``, ``lossfree_oracle_parity``, the
+  ``tier_*`` parity pair, and the ``shard_*`` fault-tolerance pair —
+  evacuation parity and the rebalance loss contract) may not go
+  true→false; ``recall_sampled`` may not drop by more than the same
+  relative tolerance.
 
 Missing metrics are skipped on either side (early rounds carry fewer
 keys), so the gate accepts the existing r01→r05 trajectory replayed
@@ -51,6 +54,8 @@ FLAG_METRICS = (
     "lossfree_oracle_parity",
     "tier_match_parity",
     "tier_counters_zero",
+    "shard_evac_parity",
+    "shard_rebalance_lossfree",
 )
 #: Ratio metrics guarded like rates (0..1, higher is better).
 RATIO_METRICS = ("recall_sampled",)
@@ -73,6 +78,15 @@ def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         # Nested tier block -> flat ``tier_*`` keys for the flag guard.
         flat["tier_match_parity"] = tier.get("match_parity")
         flat["tier_counters_zero"] = tier.get("counters_zero")
+    resilience = parsed.get("resilience")
+    shard = (
+        resilience.get("shard") if isinstance(resilience, dict) else None
+    )
+    if isinstance(shard, dict):
+        # Nested resilience.shard block (BENCH_r08+) -> flat ``shard_*``
+        # keys: the exactly-once-under-fault flags join the flag guard.
+        flat["shard_evac_parity"] = shard.get("evac_parity")
+        flat["shard_rebalance_lossfree"] = shard.get("rebalance_lossfree")
     for k in FLAG_METRICS:
         v = flat.get(k)
         if isinstance(v, bool):

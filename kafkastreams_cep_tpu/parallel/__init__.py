@@ -15,7 +15,12 @@ fixed-shape engine state, so
 
 from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.parallel.seqpar import TimeShardedStencil
-from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher, key_mesh
+from kafkastreams_cep_tpu.parallel.sharding import (
+    ShardedMatcher,
+    ShardLost,
+    key_mesh,
+    surviving_mesh,
+)
 from kafkastreams_cep_tpu.parallel.stacked import (
     StackedBankMatcher,
     choose_bank,
@@ -24,10 +29,12 @@ from kafkastreams_cep_tpu.parallel.tiered import TieredBatchMatcher
 
 __all__ = [
     "BatchMatcher",
+    "ShardLost",
     "ShardedMatcher",
     "StackedBankMatcher",
     "TieredBatchMatcher",
     "TimeShardedStencil",
     "choose_bank",
     "key_mesh",
+    "surviving_mesh",
 ]

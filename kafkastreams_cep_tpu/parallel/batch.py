@@ -109,6 +109,46 @@ def lane_scan(step_one):
     return scan
 
 
+def guarded_scan_fallback(fast, make_slow, on_fallback=None,
+                          what="whole-scan kernel"):
+    """Guarded first call of a fused whole-scan kernel, shared by
+    :class:`BatchMatcher` and ``parallel/sharding.ShardedMatcher`` so the
+    failure-classification policy can never drift between the single-chip
+    and sharded paths.
+
+    The kernel traces user predicates into the Pallas program, so a
+    pattern that cannot lower to Mosaic fails at the first *compiled*
+    call, not at build time — only that class of failure
+    (:func:`is_lowering_error`) permanently swaps in ``make_slow()``.
+    Anything transient — device OOM, interrupts, preemption, an injected
+    device fault — re-raises with the kernel still armed, so the next
+    call (e.g. a supervisor recovery retry) runs the fused path again
+    instead of silently degrading for the rest of the process.
+    ``on_fallback`` (if given) runs once at the permanent swap, for the
+    owner's ``uses_scan_kernel`` bookkeeping.
+    """
+    slow = None
+
+    def scan(state, events):
+        nonlocal slow
+        if slow is None:
+            try:
+                return fast(state, events)
+            except Exception as e:
+                if not is_lowering_error(e):
+                    raise
+                logger.warning(
+                    "%s failed to lower (%s); falling back to the "
+                    "per-step path", what, e,
+                )
+                slow = make_slow()
+                if on_fallback is not None:
+                    on_fallback()
+        return slow(state, events)
+
+    return scan
+
+
 def kernel_lane_step(phases, interpret: bool = False, qids=None):
     """A ``[K]``-batched step whose walk pass runs the fused Pallas kernel.
 
@@ -320,37 +360,20 @@ class BatchMatcher:
             else self._scan_fn
 
     def _with_fallback(self, full_scan):
-        """The whole-scan kernel traces user predicates INTO the Pallas
-        program, so a pattern that doesn't lower to Mosaic fails at the
-        first compiled call, not at build time — catch that call and
-        permanently fall back to the per-step path.  Only
-        lowering/compilation failures trigger the permanent fallback
-        (:func:`is_lowering_error`); transient runtime errors — device OOM,
-        interrupts, preemption — propagate so one bad call cannot silently
-        disable the kernel for the rest of the process."""
-        fast = jax.jit(full_scan)
-        slow = None
+        """:func:`guarded_scan_fallback` over this matcher's per-step
+        path — see the helper for the failure-classification policy."""
 
-        def scan(state, events):
-            nonlocal slow
-            if slow is None:
-                try:
-                    return fast(state, events)
-                except Exception as e:
-                    if not is_lowering_error(e):
-                        raise
-                    logger.warning(
-                        "whole-scan kernel failed to lower (%s); falling "
-                        "back to the per-step path", e,
-                    )
-                    self.uses_scan_kernel = False
-                    if self.uses_walk_kernel:
-                        slow = jax.jit(kernel_lane_scan(self._step_fn))
-                    else:
-                        slow = jax.jit(lane_scan(self.matcher._step_fn))
-            return slow(state, events)
+        def make_slow():
+            if self.uses_walk_kernel:
+                return jax.jit(kernel_lane_scan(self._step_fn))
+            return jax.jit(lane_scan(self.matcher._step_fn))
 
-        return scan
+        def on_fallback():
+            self.uses_scan_kernel = False
+
+        return guarded_scan_fallback(
+            jax.jit(full_scan), make_slow, on_fallback
+        )
 
     @property
     def names(self):

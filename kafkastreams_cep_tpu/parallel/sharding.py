@@ -34,7 +34,7 @@ from kafkastreams_cep_tpu.engine.matcher import (
 from kafkastreams_cep_tpu.parallel.batch import (
     _select_walk_kernel,
     broadcast_state,
-    is_lowering_error,
+    guarded_scan_fallback,
     kernel_lane_scan,
     kernel_lane_step,
     lane_scan,
@@ -43,6 +43,43 @@ from kafkastreams_cep_tpu.parallel.batch import (
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("parallel.sharding")
+
+
+class ShardLost(RuntimeError):
+    """A mesh shard (device) is dead or unreachable.
+
+    Raised by deployment probes / injected at the ``shard.dispatch``
+    failpoint; the supervisor's evacuation path catches it, shrinks the
+    mesh to the survivors (:func:`surviving_mesh`), and restores-and-
+    replays onto the sub-mesh (``runtime/supervisor.py``).
+    ``shard`` is the dead shard's index along the mesh's lane axis.
+    """
+
+    def __init__(self, msg: str = "shard lost", shard: int = 0):
+        super().__init__(msg)
+        self.shard = int(shard)
+
+
+def surviving_mesh(mesh: Mesh, dead, num_lanes: int) -> Optional[Mesh]:
+    """The degraded-mode mesh after losing the shards in ``dead``.
+
+    Keeps the largest prefix of surviving devices whose count divides
+    ``num_lanes`` (the ``ShardedMatcher`` contiguous-block constraint) —
+    documented degraded-mode policy: capacity may shrink below the
+    survivor count to keep lane blocks equal-sized, and a single-device
+    mesh (``n=1``) is always reachable since every ``K`` divides by 1.
+    Raises when every shard is dead.
+    """
+    dead = {int(d) for d in dead}
+    survivors = [
+        d for i, d in enumerate(mesh.devices.flat) if i not in dead
+    ]
+    if not survivors:
+        raise ValueError("no surviving devices: every mesh shard is dead")
+    m = len(survivors)
+    while num_lanes % m:
+        m -= 1
+    return key_mesh(survivors[:m], axis=mesh.axis_names[0])
 
 
 def _shard_map(*args, **kwargs):
@@ -164,25 +201,17 @@ class ShardedMatcher:
         self._stats = jax.jit(shard(local_stats, P()))
 
     def _scan_with_fallback(self, fast, make_slow):
-        slow = None
+        """:func:`parallel.batch.guarded_scan_fallback` — one shared
+        classification policy with the single-chip matcher, so a
+        transient device error on the sharded kernel path retries with
+        the kernel armed instead of permanently disabling it."""
 
-        def scan(state, events):
-            nonlocal slow
-            if slow is None:
-                try:
-                    return fast(state, events)
-                except Exception as e:
-                    if not is_lowering_error(e):
-                        raise
-                    logger.warning(
-                        "sharded whole-scan kernel failed to lower (%s); "
-                        "falling back to the per-step path", e,
-                    )
-                    self.uses_scan_kernel = False
-                    slow = make_slow()
-            return slow(state, events)
+        def on_fallback():
+            self.uses_scan_kernel = False
 
-        return scan
+        return guarded_scan_fallback(
+            fast, make_slow, on_fallback, what="sharded whole-scan kernel"
+        )
 
     @property
     def names(self):

@@ -36,10 +36,14 @@ from kafkastreams_cep_tpu.runtime.ingest import (
 )
 from kafkastreams_cep_tpu.runtime.migrate import (
     migrate_processor,
+    move_lanes,
+    plan_rebalance,
+    repartition_state,
     widen_state,
 )
 from kafkastreams_cep_tpu.runtime.supervisor import (
     HealthReport,
+    ShardPolicy,
     Supervisor,
     check_health,
 )
@@ -55,9 +59,13 @@ __all__ = [
     "IngestPolicy",
     "InputRejected",
     "Record",
+    "ShardPolicy",
     "Supervisor",
     "check_health",
     "migrate_processor",
+    "move_lanes",
+    "plan_rebalance",
+    "repartition_state",
     "save_checkpoint",
     "load_checkpoint",
     "restore_processor",

@@ -139,6 +139,17 @@ def save_checkpoint(
         "pipeline": processor.pipeline,
         "drain_interval": processor.drain_interval,
         "lane_of": dict(processor._lane_of),
+        # Which mesh wrote this snapshot (None/absent: single device).
+        # Lane rows are stored in LOGICAL lane order — mesh-agnostic — so
+        # these are provenance, not placement: a restore onto a different
+        # device count re-places the same rows through repartition_state
+        # (see restore_processor) and logs the assignment change.
+        "mesh_size": (
+            int(processor.mesh.devices.size)
+            if processor.mesh is not None
+            else None
+        ),
+        "lane_shards": processor.lane_shards(),
         "next_offset": processor._next_offset.copy(),
         "off_base": processor._off_base.copy(),
         "events": [dict(d) for d in processor._events],
@@ -220,12 +231,23 @@ def restore_processor(
     the mesh (or single device) that wrote the snapshot — the rebalance
     analog: lanes re-place onto the new device set, exactly like Kafka
     Streams restoring changelogged partitions onto a resized consumer
-    group.  ``num_lanes`` must divide the new mesh size.
+    group.  The mesh size must divide ``num_lanes`` (refused with a clear
+    error, not a shard_map internality); a device-count change routes the
+    state through ``runtime.migrate.repartition_state`` — identity, by
+    the relabeling invariant below — and is logged as an explicit
+    assignment change.
     """
     if ckpt is None:
         ckpt = load_checkpoint(path)
     header = ckpt["header"]
     config = EngineConfig(**header["config"])
+    target_devs = int(mesh.devices.size) if mesh is not None else 1
+    if int(header["num_lanes"]) % target_devs:
+        raise ValueError(
+            f"checkpoint holds {header['num_lanes']} lanes, not divisible "
+            f"by the {target_devs}-device restore mesh — pick a mesh whose "
+            "size divides the lane count (parallel/sharding.py contract)"
+        )
     proc = CEPProcessor(
         pattern,
         header["num_lanes"],
@@ -255,7 +277,29 @@ def restore_processor(
             f"{proc_dtypes} vs checkpoint {header['state_dtypes']} "
             "(typed agg bit patterns are not translatable across dtypes)"
         )
-    proc.state = proc.place(_unflatten_state(proc.state, ckpt["arrays"]))
+    state = _unflatten_state(proc.state, ckpt["arrays"])
+    written_devs = int(header.get("mesh_size") or 1)
+    if written_devs != target_devs:
+        # Snapshot rows are logical lanes and every lane→shard assignment
+        # this runtime produces (evacuation, rebalance — runtime/migrate.py
+        # move_lanes) RELABELS lanes so the live assignment is always the
+        # contiguous identity.  Restoring onto a different device count is
+        # therefore the identity repartition re-placed in new-sized blocks;
+        # routing it through repartition_state keeps one audited
+        # re-assignment point (shape/permutation validation, host
+        # normalization) instead of a silent device_put.
+        from kafkastreams_cep_tpu.runtime import migrate as migrate_mod
+
+        state = migrate_mod.repartition_state(
+            state, np.arange(int(header["num_lanes"]))
+        )
+        logger.info(
+            "checkpoint written on %d device(s) restored onto %d: lanes "
+            "re-placed in %d-lane shard blocks",
+            written_devs, target_devs,
+            int(header["num_lanes"]) // target_devs,
+        )
+    proc.state = proc.place(state)
     # The drained-handle ordering base is derivable from device state:
     # step_seq is the per-lane step counter (identical across lanes — all
     # lanes step together), and a restore resumes exactly at it.  Tiered

@@ -67,15 +67,20 @@ walk paths — is property-tested in ``tests/test_migrate.py``.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EngineState
 from kafkastreams_cep_tpu.ops.slab import SlabState
+from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("runtime.migrate")
+
+#: Sentinel for ``move_lanes(mesh=...)``: "keep the processor's current
+#: mesh" must be distinguishable from "explicitly unmeshed" (``None``).
+_KEEP_MESH = object()
 
 # Config fields that are array-shape dims (may only grow) vs semantic
 # switches (must not change under a live migration: they alter the match
@@ -366,5 +371,199 @@ def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
         "migrated processor %s -> %s",
         {f: getattr(old_config, f) for f in _SHAPE_DIMS},
         {f: getattr(new_config, f) for f in _SHAPE_DIMS},
+    )
+    return new_proc
+
+
+# -- lane repartitioning (shard evacuation / hot-key rebalancing) ------------
+#
+# Why a lane permutation is a pure relabeling (the proof burden)
+# --------------------------------------------------------------
+# The mesh shards the leading ``[K]`` lane axis into contiguous blocks
+# (``parallel/sharding.py``: ``NamedSharding(mesh, P(axis))``), so moving
+# lanes between shards == permuting *logical* lane indices and re-placing.
+# That permutation is unobservable, because lane identity is entirely
+# internal:
+#
+# * **Device state.**  Every leaf of ``EngineState``/``SlabState`` (and the
+#   ``TieredState`` stencil carry) carries a leading ``[K]`` axis, and the
+#   engine is built by lifting a per-lane step with ``vmap``
+#   (``parallel/batch.py: lane_step``) — no operation reads across lanes.
+#   The only collective on the sharded path is the ``stats`` reduction
+#   (``psum`` of per-lane sums), and a sum is permutation-invariant.
+# * **External identity is the key, not the lane.**  Records reach a lane
+#   only through the host map ``_lane_of`` and matches are emitted keyed
+#   by the original key with record-rank ordering (``processor._decode``
+#   orders by arrival rank / ``step_seq``, never by lane index).
+#   Permuting the state rows and every lane-indexed host structure —
+#   ``_lane_of``/``_key_of``, per-lane offsets, the event mirror, queued
+#   column batches, the ingest guard's per-lane source high-waters — by
+#   the SAME permutation therefore yields a processor whose observable
+#   behavior (matches, order, counters) is bit-identical.
+# * **Counters.**  Per-lane counters permute with their lanes; every
+#   reported total is a lane sum and is unchanged.  A repartition never
+#   forgives or invents loss — ``canonical_state`` of the moved state is
+#   the lane-permuted ``canonical_state`` of the original, exactly
+#   (property-tested in ``tests/test_shard_fault.py``, jnp and kernel
+#   walk paths, two-tier slab, live handle ring, tiered carry).
+
+
+def repartition_state(state, perm: Sequence[int]):
+    """Permute the leading ``[K]`` lane axis of every state leaf:
+    ``new[i] = old[perm[i]]``.  Returns host numpy arrays; callers
+    re-place onto the target mesh (``CEPProcessor.place``).
+
+    ``perm`` must be a permutation of ``range(K)``.  Works on
+    ``EngineState`` and ``TieredState`` alike — the stencil prefix carry
+    is per-lane ``[K, ...]`` shaped and permutes with its engine half.
+    """
+    import jax as _jax
+
+    perm = np.asarray(perm, dtype=np.int64).reshape(-1)
+    k = perm.shape[0]
+    if not np.array_equal(np.sort(perm), np.arange(k)):
+        raise ValueError(
+            f"perm is not a permutation of range({k}): {perm.tolist()}"
+        )
+
+    def take(x):
+        arr = np.asarray(x)
+        if arr.ndim == 0 or arr.shape[0] != k:
+            raise ValueError(
+                f"state leaf shape {arr.shape} has no leading [{k}] lane "
+                "axis; repartition_state requires lane-batched state"
+            )
+        return arr[perm]
+
+    return _jax.tree_util.tree_map(take, state)
+
+
+def plan_rebalance(
+    loads: Sequence[int], num_shards: int
+) -> Optional[np.ndarray]:
+    """A lane permutation that balances per-shard load, or ``None``.
+
+    ``loads`` is a per-lane cost vector (the PR 6 heavy-hitter signal:
+    walk + extract + drain hops over the last window).  Shards own
+    contiguous blocks of ``K / num_shards`` lanes, so balancing =
+    choosing which lanes land in which block: greedy LPT — lanes in
+    descending cost order, each to the least-loaded shard with block
+    capacity left — then the permutation is the concatenation of the
+    blocks.  Deterministic (stable sort, index tie-break).
+
+    Returns ``None`` when the plan would not strictly reduce the maximum
+    per-shard load (hysteresis belongs to the caller; this is the
+    no-improvement guard so a balanced mesh never thrashes).
+    """
+    loads = np.asarray(loads, dtype=np.int64).reshape(-1)
+    k = loads.shape[0]
+    n = int(num_shards)
+    if n < 2 or k % n:
+        return None
+    per = k // n
+    old_max = int(loads.reshape(n, per).sum(axis=1).max())
+    order = np.argsort(-loads, kind="stable")
+    shard_load = np.zeros(n, dtype=np.int64)
+    blocks: list = [[] for _ in range(n)]
+    for lane in order:
+        open_shards = [s for s in range(n) if len(blocks[s]) < per]
+        dest = min(open_shards, key=lambda s: (int(shard_load[s]), s))
+        blocks[dest].append(int(lane))
+        shard_load[dest] += int(loads[lane])
+    if int(shard_load.max()) >= old_max:
+        return None
+    return np.asarray([lane for b in blocks for lane in b], dtype=np.int64)
+
+
+def move_lanes(pattern, proc, perm=None, mesh=_KEEP_MESH):
+    """Rebuild a live :class:`CEPProcessor` under a new lane→shard
+    assignment: state rows permuted by ``perm`` (:func:`repartition_state`)
+    and re-placed onto ``mesh`` — the same mesh (hot-key rebalancing), a
+    shrunk surviving sub-mesh (shard evacuation), or ``None`` (degrade to
+    a single device).
+
+    Every lane-indexed host structure moves through the same permutation,
+    so key→lane routing, offset dedup, the event mirror, and the ingest
+    guard's per-lane high-waters stay consistent with the relabeled state
+    (see the module-level pure-relabeling argument).  Like
+    :func:`migrate_processor`, the processor must hold no undecoded
+    pipelined batch — ``flush()`` first.
+    """
+    from kafkastreams_cep_tpu.runtime.processor import CEPProcessor
+
+    if getattr(proc, "_pending", None) is not None:
+        raise ValueError(
+            "pipelined processor holds an undecoded batch; call flush() "
+            "before moving lanes (device outputs are lane-ordered by the "
+            "old assignment)"
+        )
+    k = proc.num_lanes
+    perm = (
+        np.arange(k, dtype=np.int64)
+        if perm is None
+        else np.asarray(perm, dtype=np.int64).reshape(-1)
+    )
+    if perm.shape[0] != k or not np.array_equal(np.sort(perm), np.arange(k)):
+        raise ValueError(
+            f"perm must be a permutation of range({k}): {perm.tolist()}"
+        )
+    new_mesh = proc.mesh if mesh is _KEEP_MESH else mesh
+    # Fault site: a move that dies here leaves the OLD processor fully
+    # intact — the caller keeps the old assignment and nothing is lost.
+    _failpoint("rebalance.move")
+    inv = np.empty(k, dtype=np.int64)
+    inv[perm] = np.arange(k, dtype=np.int64)
+    config = proc.batch.matcher.config
+    new_proc = CEPProcessor(
+        pattern,
+        k,
+        config,
+        topic=proc.topic,
+        epoch=proc.epoch,
+        gc_events=proc.gc_events,
+        dedup=proc.dedup,
+        gc_interval=proc.gc_interval,
+        gc_events_interval=proc.gc_events_interval,
+        decode_budget=proc.decode_budget,
+        pipeline=proc.pipeline,
+        drain_interval=proc.drain_interval,
+        mesh=new_mesh,
+    )
+    if list(new_proc.batch.names) != list(proc.batch.names):
+        raise ValueError(
+            "pattern topology changed across the move: stages "
+            f"{new_proc.batch.names} vs live {proc.batch.names}"
+        )
+    new_proc.state = new_proc.place(repartition_state(proc.state, perm))
+    # Host bookkeeping: old lane ``p`` becomes new lane ``inv[p]``.
+    new_proc._lane_of = {key: int(inv[l]) for key, l in proc._lane_of.items()}
+    new_proc._key_of = {int(inv[l]): key for l, key in proc._key_of.items()}
+    new_proc._next_offset = proc._next_offset[perm].copy()
+    new_proc._off_base = proc._off_base[perm].copy()
+    new_proc._events = [dict(proc._events[int(p)]) for p in perm]
+    new_proc._col_batches = [
+        tuple(
+            [leaf[perm] for leaf in part] if isinstance(part, list)
+            else np.asarray(part)[perm]
+            for part in entry
+        )
+        for entry in proc._col_batches
+    ]
+    new_proc._value_proto = proc._value_proto
+    new_proc._step_base = proc._step_base  # pending-handle ordering base
+    new_proc.metrics = proc.metrics  # continuity: one stream, one meter
+    new_proc.flight = proc.flight
+    new_proc._dlq_base = proc._dlq_base
+    new_proc._guard = proc._guard
+    if new_proc._guard is not None:
+        new_proc._guard.source_hw = {
+            int(inv[l]): hw for l, hw in new_proc._guard.source_hw.items()
+        }
+    moved = int((perm != np.arange(k)).sum())
+    logger.info(
+        "moved %d/%d lanes onto %s",
+        moved, k,
+        "no mesh" if new_mesh is None
+        else f"{new_mesh.devices.size}-device mesh",
     )
     return new_proc

@@ -882,6 +882,11 @@ class CEPProcessor:
         # window the supervisor's restore-and-replay must cover.
         _failpoint("device.dispatch")
         if self.mesh is not None:
+            # Shard fault site: the host→mesh transfer is where a dead
+            # device first surfaces on the sharded path — state untouched,
+            # so the supervisor's evacuation can restore-and-replay onto
+            # the surviving sub-mesh (arm with ShardLost to drive it).
+            _failpoint("shard.dispatch")
             events = self.batch.shard_events(events)
 
         base = self._step_base
@@ -1193,6 +1198,17 @@ class CEPProcessor:
             for o in dead:
                 del store[o]
         self._col_batches.clear()
+
+    def lane_shards(self) -> Optional[List[int]]:
+        """The live lane→shard assignment (contiguous blocks over the
+        mesh's lane axis), or ``None`` unmeshed.  Recorded in checkpoint
+        headers so a snapshot states which mesh wrote it and a restore
+        onto a different device count is an explicit, logged event
+        (``runtime/checkpoint.py``)."""
+        if self.mesh is None:
+            return None
+        per = self.num_lanes // int(self.mesh.devices.size)
+        return [k // per for k in range(self.num_lanes)]
 
     def place(self, state):
         """Device placement for host-built state (mesh-aware) — used by

@@ -32,6 +32,21 @@ Matches replayed during recovery are suppressed (they were already
 emitted), preserving exactly-once *emission* for everything the caller saw
 before the failure — one better than the reference, whose at-least-once
 replay duplicates and corrupts runs (``README.md:108``).
+
+On a meshed processor (``mesh=`` kwarg) the same machinery covers **shard
+failure**: a dead device (``ShardLost`` out of the dispatch, or a
+``shard_probe`` report attached to any device error) triggers *evacuation*
+— restore the last checkpoint and replay the journal onto the surviving
+sub-mesh (``parallel.sharding.surviving_mesh``; lanes re-place through
+``runtime.migrate.repartition_state``), pin the new assignment with an
+immediate snapshot, and retry the batch degraded but exactly-once.
+Straggler watermarks (:meth:`Supervisor.observe_shard_latency`, fed by the
+deployment's per-host heartbeat) declare a lagging shard and evacuate it
+at the next batch boundary; and at checkpoint boundaries the PR 6 per-key
+heavy-hitter counters drive **hot-key rebalancing** — a pure lane
+relabeling (``runtime.migrate.move_lanes``) that moves hot lanes off a
+saturated shard with zero dropped or duplicated matches
+(:class:`ShardPolicy` hysteresis keeps assignments from thrashing).
 """
 
 from __future__ import annotations
@@ -50,6 +65,7 @@ from kafkastreams_cep_tpu.engine import sizing
 from kafkastreams_cep_tpu.engine.matcher import EngineConfig
 from kafkastreams_cep_tpu.engine.sizing import EscalationPolicy
 from kafkastreams_cep_tpu.native.journal import Journal
+from kafkastreams_cep_tpu.parallel.sharding import ShardLost, surviving_mesh
 from kafkastreams_cep_tpu.runtime import checkpoint as ckpt_mod
 from kafkastreams_cep_tpu.runtime import migrate as migrate_mod
 from kafkastreams_cep_tpu.runtime.processor import (
@@ -114,6 +130,44 @@ def check_health(processor: CEPProcessor) -> HealthReport:
     )
 
 
+@dataclass
+class ShardPolicy:
+    """When a meshed supervisor declares a shard sick and when it moves
+    lanes — both sides deliberately hysteretic, because evacuation and
+    rebalancing each cost a restore-or-move plus a pinning snapshot and
+    must not thrash on noise.
+
+    Straggler side (fed by :meth:`Supervisor.observe_shard_latency`): a
+    shard whose step-latency watermark (max over the last
+    ``straggler_window`` observations) exceeds ``straggler_factor`` × the
+    median of the other shards' watermarks on ``straggler_streak``
+    consecutive observations is declared lagging; with
+    ``evacuate_stragglers`` it is evacuated at the next batch boundary,
+    exactly like a dead shard (the slow host may be dying — and even if
+    not, the whole mesh steps at the straggler's pace).
+
+    Skew side (checked at checkpoint boundaries from the per-lane hop
+    deltas behind ``CEPProcessor.per_key_cost``): a boundary *trips* when
+    the window saw at least ``rebalance_min_hops`` total hops and the
+    hottest shard carried more than ``rebalance_skew`` × the mean
+    per-shard load.  After ``rebalance_streak`` consecutive tripping
+    boundaries (and at least ``rebalance_cooldown`` boundaries since the
+    last move), hot lanes are re-spread greedily
+    (``runtime.migrate.plan_rebalance``) and moved via
+    ``runtime.migrate.move_lanes`` — a pure relabeling, so the stream
+    sees no dropped or duplicated matches.
+    """
+
+    straggler_factor: float = 3.0
+    straggler_window: int = 8
+    straggler_streak: int = 3
+    evacuate_stragglers: bool = True
+    rebalance_skew: float = 2.0
+    rebalance_min_hops: int = 64
+    rebalance_streak: int = 2
+    rebalance_cooldown: int = 1
+
+
 class Supervisor:
     """Checkpointing, health-probing, auto-recovering processor wrapper.
 
@@ -162,6 +216,8 @@ class Supervisor:
         retry_backoff_ms: float = 50.0,
         retry_backoff_cap_ms: float = 5000.0,
         processor: Optional[CEPProcessor] = None,
+        shard_policy: Optional[ShardPolicy] = None,
+        shard_probe=None,
         _resuming: bool = False,
         **proc_kwargs,
     ):
@@ -256,6 +312,41 @@ class Supervisor:
         # not yet returned to the caller (drained at the end of process();
         # survives a checkpoint-save failure so nothing is ever lost).
         self._unclaimed: List[Tuple[Hashable, Sequence]] = []
+        # Mesh fault tolerance (module docstring): on by default whenever
+        # the processor is meshed — a dead shard with no policy would be a
+        # hard crash, which is strictly worse than degraded continuation.
+        # Pass ``shard_policy=False`` to opt out explicitly.
+        if shard_policy is False:
+            self._shard_policy: Optional[ShardPolicy] = None
+        elif shard_policy is not None:
+            self._shard_policy = shard_policy
+        else:
+            self._shard_policy = (
+                ShardPolicy() if self._mesh() is not None else None
+            )
+        # Optional deployment hook: zero-arg callable returning the shard
+        # indices an external health source (host heartbeat, PCIe error
+        # telemetry) currently believes dead.  Consulted when a dispatch
+        # fails with a *generic* device error — ShardLost needs no probe.
+        self._shard_probe = shard_probe
+        self.evacuations = 0
+        self.rebalances = 0
+        self.rebalance_failures = 0
+        self.lanes_moved = 0
+        self.stragglers = 0
+        # Straggler bookkeeping: recent step latencies per shard index,
+        # consecutive over-watermark counts, and shards declared lagging
+        # (evacuated at the next batch boundary).  All cleared on
+        # evacuation — shard indices are renumbered by the shrink.
+        self._shard_lat: dict = {}
+        self._lag_streak: dict = {}
+        self._lagging: set = set()
+        # Rebalance hysteresis: per-lane hop baseline for the windowed
+        # delta, consecutive tripping boundaries, boundaries since the
+        # last move.
+        self._hops_base: Optional[np.ndarray] = None
+        self._rebalance_streak = 0
+        self._boundaries_since_move = 10**9  # no cooldown before 1st move
         # After a failed append the on-disk journal is no longer a complete
         # history — appending later batches would leave a seq gap that a
         # resume would replay straight through into a wrong state.  Suspend
@@ -267,7 +358,8 @@ class Supervisor:
         # p50/p99, not just the bare integers above.
         self.trace = self._proc_kwargs.get("trace_sink")
         self.telemetry = MetricsRegistry()
-        for _n in ("checkpoint", "recover", "escalate"):
+        for _n in ("checkpoint", "recover", "escalate", "evacuate",
+                   "rebalance"):
             self.telemetry.histogram(f"phase.{_n}")
         # Flight recorder (runtime/flight.py): pass ``flight=`` like any
         # processor kwarg; the supervisor owns the dump triggers — crash
@@ -494,6 +586,22 @@ class Supervisor:
     def _process_supervised(
         self, records: List[Record], corr: str
     ) -> List[Tuple[Hashable, Sequence]]:
+        # Shards declared lagging by observe_shard_latency() are evacuated
+        # at the batch boundary — before the dispatch, where the restore
+        # and replay are cheapest and nothing is in flight.
+        if (
+            self._lagging
+            and self._shard_policy is not None
+            and self._shard_policy.evacuate_stragglers
+        ):
+            mesh = self._mesh()
+            if mesh is not None and int(mesh.devices.size) > 1:
+                lagging = sorted(self._lagging)
+                logger.warning(
+                    "evacuating lagging shard(s) %s at the batch boundary",
+                    lagging,
+                )
+                self._evacuate(lagging, corr)
         for attempt in range(self.max_retries + 1):
             try:
                 # Captured per attempt (a recovery resets the pipeline):
@@ -513,6 +621,28 @@ class Supervisor:
                 # exception short-circuits: JAX surfaces some real device
                 # faults as bare ValueError, and those must recover.
                 raise
+            except ShardLost as e:
+                # A typed shard loss out of the meshed dispatch: the
+                # device is gone, so restore-and-replay onto the SAME mesh
+                # (plain recovery) would re-dispatch straight into the
+                # dead device.  Evacuate instead: shrink to the surviving
+                # sub-mesh and retry there.  Unmeshed or single-device,
+                # there is nothing to evacuate onto — crash.
+                mesh = self._mesh()
+                if (
+                    mesh is None
+                    or int(mesh.devices.size) < 2
+                    or attempt >= self.max_retries
+                ):
+                    if self.flight is not None:
+                        self.flight.dump("crash", corr=corr)
+                    raise
+                logger.exception(
+                    "shard %d lost on a %d-record batch; evacuating onto "
+                    "the surviving sub-mesh", e.shard, len(records),
+                )
+                self._evacuate([e.shard], corr)
+                self._backoff(attempt)
             except Exception:
                 if attempt >= self.max_retries:
                     # Crash: retries exhausted, the exception propagates
@@ -521,11 +651,23 @@ class Supervisor:
                     if self.flight is not None:
                         self.flight.dump("crash", corr=corr)
                     raise
-                logger.exception(
-                    "processor failed on a %d-record batch; recovering",
-                    len(records),
-                )
-                self._recover(corr)
+                # A generic device error does not say WHICH device (JAX
+                # surfaces resets as bare RuntimeError); ask the optional
+                # external probe before falling back to same-mesh
+                # recovery.
+                dead = self._probe_dead_shards()
+                if dead:
+                    logger.exception(
+                        "processor failed and the shard probe reports "
+                        "shard(s) %s dead; evacuating", sorted(dead),
+                    )
+                    self._evacuate(dead, corr)
+                else:
+                    logger.exception(
+                        "processor failed on a %d-record batch; recovering",
+                        len(records),
+                    )
+                    self._recover(corr)
                 self._backoff(attempt)
         if self._policy is not None:
             matches = self._maybe_escalate(records, matches, had_pending, corr)
@@ -568,6 +710,11 @@ class Supervisor:
         # un-journaled batch and re-arms journaling).
         force_ckpt = self._journal_suspended
         if force_ckpt or self._batches_since_ckpt >= self.checkpoint_every:
+            # Hot-key rebalance check BEFORE the snapshot: a move landing
+            # here is immediately pinned by the checkpoint below, so every
+            # recovery and resume replays under the new lane assignment.
+            if self._shard_policy is not None:
+                self._maybe_rebalance()
             # A failed snapshot (disk full, ...) must not lose the batch's
             # matches: the journal still covers everything since the last
             # good snapshot, so log, count, and retry next batch.
@@ -677,6 +824,248 @@ class Supervisor:
         logger.info(
             "recovered: checkpoint=%s, %d journaled records replayed",
             self._has_checkpoint, replayed,
+        )
+        # The rebalance baseline indexes lanes in the *live* processor's
+        # order; a rollback may precede the last move, so re-measure.
+        self._hops_base = None
+
+    # -- mesh fault tolerance ------------------------------------------------
+
+    def _mesh(self):
+        """The mesh the NEXT (re)built processor will land on — the
+        ``mesh`` proc kwarg, which evacuation rewrites; falls back to the
+        live processor's mesh for an injected (resumed) processor."""
+        mesh = self._proc_kwargs.get("mesh")
+        if mesh is None:
+            mesh = getattr(self.processor, "mesh", None)
+        return mesh
+
+    def _probe_dead_shards(self) -> set:
+        if self._shard_probe is None or self._shard_policy is None:
+            return set()
+        mesh = self._mesh()
+        if mesh is None or int(mesh.devices.size) < 2:
+            return set()
+        try:
+            return {int(s) for s in (self._shard_probe() or ())}
+        except Exception:
+            logger.exception("shard probe failed; treating as no report")
+            return set()
+
+    def _evacuate(self, dead, corr: Optional[str] = None) -> None:
+        """Move the lost shard(s)' lanes onto the surviving sub-mesh.
+
+        Same rollback spine as :meth:`_recover` — restore the last
+        checkpoint and replay the journal tail, deterministic and
+        emission-suppressed — but the rebuilt processor is placed on
+        ``surviving_mesh(mesh, dead)`` (``_proc_kwargs["mesh"]`` is
+        rewritten first, so ``_restore_tail`` and every later rebuild
+        land there; ``checkpoint.restore_processor`` routes the lane
+        re-placement through ``migrate.repartition_state``).  The shrunk
+        assignment is pinned with an immediate snapshot: a recovery or
+        resume between here and the next periodic snapshot must not
+        re-place lanes on the dead device.  Processing continues
+        *degraded* — fewer devices, same lanes, exactly-once emission.
+        """
+        mesh = self._mesh()
+        dead = sorted({int(d) for d in dead})
+        new_mesh = surviving_mesh(mesh, dead, self.processor.num_lanes)
+        if self.flight is not None:
+            self.flight.note(
+                evacuation=self.evacuations + 1, dead_shards=dead
+            )
+            self.flight.dump("evacuate", corr=corr)
+        with maybe_span(
+            self.trace, "evacuate", corr=corr, seq=self._seq,
+            dead_shards=dead, survivors=int(new_mesh.devices.size),
+        ) as sp, timed_histogram(self.telemetry, "phase.evacuate"):
+            self._proc_kwargs["mesh"] = new_mesh
+            replayed = self._restore_tail()
+            sp["replayed_records"] = replayed
+            sp["from_checkpoint"] = self._has_checkpoint
+            try:
+                self._unclaimed.extend(self.checkpoint())
+            except Exception:
+                self.checkpoint_failures += 1
+                logger.exception(
+                    "post-evacuation checkpoint failed; a resume before "
+                    "the next good snapshot re-places lanes itself "
+                    "(restore_processor repartitions on mesh-size change)"
+                )
+        self.evacuations += 1
+        # Shard indices are renumbered by the shrink: every piece of
+        # straggler and skew bookkeeping keyed by the old numbering is
+        # meaningless now.
+        self._shard_lat.clear()
+        self._lag_streak.clear()
+        self._lagging.clear()
+        self._hops_base = None
+        if self._policy is not None:
+            self._counter_base = self._capacity_counters()
+            self._ingest_base = self._ingest_loss_counters()
+        logger.warning(
+            "shard(s) %s evacuated: %d lanes now on %d device(s), "
+            "%d journaled records replayed (degraded but exactly-once)",
+            dead, self.processor.num_lanes, int(new_mesh.devices.size),
+            replayed,
+        )
+
+    def observe_shard_latency(self, shard: int, seconds: float) -> bool:
+        """Feed one shard's step-latency watermark (per-host heartbeat in
+        a real deployment; the bench and chaos harness call it directly).
+
+        A shard whose watermark — max over the last
+        ``ShardPolicy.straggler_window`` observations — exceeds
+        ``straggler_factor`` × the median of the other shards' watermarks
+        on ``straggler_streak`` consecutive observations is declared
+        lagging.  Returns True when ``shard`` is currently declared; with
+        ``evacuate_stragglers`` the declaration triggers evacuation at
+        the next batch boundary.
+        """
+        policy = self._shard_policy
+        if policy is None:
+            return False
+        shard = int(shard)
+        lat = self._shard_lat.setdefault(shard, [])
+        lat.append(float(seconds))
+        del lat[: -int(policy.straggler_window)]
+        others = [
+            max(v) for s, v in self._shard_lat.items() if s != shard and v
+        ]
+        if not others:
+            return shard in self._lagging
+        med = float(np.median(others))
+        if med > 0.0 and max(lat) > policy.straggler_factor * med:
+            self._lag_streak[shard] = self._lag_streak.get(shard, 0) + 1
+        else:
+            self._lag_streak[shard] = 0
+        if (
+            self._lag_streak[shard] >= policy.straggler_streak
+            and shard not in self._lagging
+        ):
+            self._lagging.add(shard)
+            self.stragglers += 1
+            if self.trace is not None:
+                self.trace.event(
+                    "straggler", shard=shard, watermark_s=max(lat),
+                    peer_median_s=med,
+                )
+            logger.warning(
+                "shard %d declared lagging (watermark %.4fs vs peer "
+                "median %.4fs); evacuation at the next batch boundary",
+                shard, max(lat), med,
+            )
+        return shard in self._lagging
+
+    def _maybe_rebalance(self) -> None:
+        """Move hot lanes off a saturated shard at a checkpoint boundary.
+
+        The signal is the windowed per-lane hop DELTA (walk + extract +
+        drain — the counters behind ``CEPProcessor.per_key_cost``) since
+        the last boundary: cumulative totals would forever punish a key
+        that was hot an hour ago.  Trip + streak + cooldown hysteresis
+        per :class:`ShardPolicy`; the move itself is
+        ``migrate.move_lanes`` with the greedy ``plan_rebalance``
+        permutation — a pure relabeling, pinned by the checkpoint that
+        immediately follows in ``_process_supervised``.  A move that
+        fails (``rebalance.move`` fault site) leaves the old processor
+        and assignment fully intact.
+        """
+        policy = self._shard_policy
+        mesh = self._mesh()
+        if policy is None or mesh is None:
+            return
+        n = int(mesh.devices.size)
+        k = self.processor.num_lanes
+        if n < 2 or k % n != 0:
+            return
+        self._boundaries_since_move += 1
+        arrays = {
+            name: np.asarray(vals, dtype=np.int64).reshape(-1)
+            for name, vals in self.processor.batch.per_lane_counters(
+                self.processor.state
+            ).items()
+            if name in ("walk_hops", "extract_hops", "drain_hops")
+        }
+        if not arrays:
+            return
+        hops = sum(arrays.values())
+        base = self._hops_base
+        if base is None or base.shape != hops.shape:
+            self._hops_base = hops
+            self._rebalance_streak = 0
+            return
+        window = hops - base
+        self._hops_base = hops
+        total = int(window.sum())
+        shard_loads = window.reshape(n, k // n).sum(axis=1)
+        mean = total / n
+        tripped = (
+            total >= policy.rebalance_min_hops
+            and float(shard_loads.max()) > policy.rebalance_skew * mean
+        )
+        if not tripped:
+            self._rebalance_streak = 0
+            return
+        self._rebalance_streak += 1
+        if (
+            self._rebalance_streak < policy.rebalance_streak
+            or self._boundaries_since_move <= policy.rebalance_cooldown
+        ):
+            return
+        perm = migrate_mod.plan_rebalance(window, n)
+        if perm is None:
+            self._rebalance_streak = 0
+            return
+        # The PR 6 heavy-hitter attribution over the same window names
+        # the keys being moved — operator-facing (span + log), the
+        # decision above is already made from the identical arrays.
+        hot = self.processor.per_key_cost(
+            top_k=4,
+            per_lane_arrays={
+                "walk_hops": window,
+                "extract_hops": np.zeros_like(window),
+                "drain_hops": np.zeros_like(window),
+            },
+        )
+        moved = int(np.sum(perm != np.arange(k)))
+        with maybe_span(
+            self.trace, "rebalance", seq=self._seq, lanes_moved=moved,
+            hot_keys=[h["key"] for h in hot["top"]],
+            shard_loads=[int(x) for x in shard_loads],
+        ), timed_histogram(self.telemetry, "phase.rebalance"):
+            if self.processor.pipeline:
+                # An undecoded device batch cannot be permuted host-side;
+                # flushing is observable emission, kept for the caller.
+                self._unclaimed.extend(self.processor.flush())
+            try:
+                self.processor = migrate_mod.move_lanes(
+                    self._pattern, self.processor, perm, mesh=mesh
+                )
+            except Exception:
+                self.rebalance_failures += 1
+                # move_lanes mutates nothing before it succeeds — the old
+                # processor and lane assignment are intact; skip this
+                # boundary and re-measure (the baseline still indexes the
+                # unmoved lane order).
+                logger.exception(
+                    "lane rebalance failed; keeping the current assignment"
+                )
+                return
+            self.processor.trace = self.trace
+            self.processor.flight = self.flight
+            self.rebalances += 1
+            self.lanes_moved += moved
+            # The baseline must follow its lanes to the new positions.
+            self._hops_base = hops[perm]
+            self._rebalance_streak = 0
+            self._boundaries_since_move = 0
+        logger.warning(
+            "hot-key rebalance #%d: moved %d lanes (window loads per "
+            "shard %s; hottest keys %s)",
+            self.rebalances, moved,
+            [int(x) for x in shard_loads],
+            [h["key"] for h in hot["top"]],
         )
 
     # -- elastic capacity escalation ----------------------------------------
@@ -900,6 +1289,11 @@ class Supervisor:
         out["journal_failures"] = self.journal_failures
         out["escalations"] = self.escalations
         out["ingest_escalations"] = self.ingest_escalations
+        out["evacuations"] = self.evacuations
+        out["rebalances"] = self.rebalances
+        out["rebalance_failures"] = self.rebalance_failures
+        out["lanes_moved"] = self.lanes_moved
+        out["stragglers"] = self.stragglers
         if self.flight is not None:
             out["flight_dumps"] = self.flight.dumps
         out["retry_backoff_ms_total"] = round(self.retry_backoff_ms_total, 3)

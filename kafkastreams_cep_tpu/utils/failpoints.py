@@ -50,6 +50,14 @@ Sites currently threaded through the runtime:
                        the adversarial window: the held set advanced
                        while device state did not, so recovery must
                        restore the buffer from the snapshot + journal
+``shard.dispatch``     meshed branch of ``CEPProcessor._dispatch``, at the
+                       host→mesh transfer — where a lost device first
+                       surfaces on the sharded path; arm with
+                       ``parallel.sharding.ShardLost`` to drive the
+                       supervisor's shard-evacuation path
+``rebalance.move``     entry of ``runtime.migrate.move_lanes``, before any
+                       state moves — a fault here must leave the old
+                       processor (and lane assignment) fully intact
 =====================  ====================================================
 """
 
@@ -79,7 +87,8 @@ _DEFAULT_EXC: Dict[str, Callable[[str], BaseException]] = {}
 
 
 def _default_exc(site: str) -> BaseException:
-    if site.startswith("device."):
+    # ``shard.*`` models a lost mesh device — device family, not disk.
+    if site.startswith(("device.", "shard.")):
         return InjectedFault(f"injected fault at {site}")
     return InjectedIOError(f"injected I/O failure at {site}")
 
@@ -219,6 +228,10 @@ SITES = (
     # record and its single-write append — a crash here must leave the
     # stream without any partial line (utils/telemetry.py Reporter.flush).
     "report.write",
+    # Mesh fault-tolerance sites (runtime/supervisor.py shard evacuation
+    # and hot-key rebalancing; see the docstring table).
+    "shard.dispatch",
+    "rebalance.move",
 )
 
 

@@ -42,14 +42,26 @@ if not os.environ.get("CEP_TEST_TPU"):
 
 
 def pytest_collection_modifyitems(config, items):
-    """Run the newest (and compile-heaviest) suite last.
+    """Run the newest (and compile-heaviest) suites last.
 
-    Tier-1 runs under a fixed wall budget; ordering the tiering suite
+    Tier-1 runs under a fixed wall budget; ordering the newest suites
     after the long-standing ones means a budget truncation cuts the
     newest coverage first instead of displacing established tests —
     the no-worse-than-baseline dot count stays monotone as suites grow.
+    Newest last: the PR 8 shard-fault suites follow the PR 7 tiering
+    suite, which follows everything else in collection order.
     """
-    late = [it for it in items if "test_tiering" in it.nodeid]
-    if late:
-        rest = [it for it in items if "test_tiering" not in it.nodeid]
-        items[:] = rest + late
+    def _age(it):
+        nid = it.nodeid
+        if (
+            "test_shard_fault" in nid
+            or "test_shard_chaos" in nid
+            or "test_chaos_schedule_tiered" in nid
+            or "test_resume_on_shrunk_mesh" in nid
+        ):
+            return 2  # PR 8: shard fault tolerance
+        if "test_tiering" in nid:
+            return 1  # PR 7: compiler tiering
+        return 0
+
+    items.sort(key=_age)  # stable: collection order kept within a tier

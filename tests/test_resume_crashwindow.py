@@ -16,6 +16,7 @@ Three windows a process crash can land in, each with a distinct contract:
   generation + live frames) replaying the full gap instead of crashing.
 """
 
+import os
 import pickle
 
 import numpy as np
@@ -246,3 +247,80 @@ def test_corrupt_snapshot_falls_back_to_previous_good(tmp_path):
     more += res.process([Record("k", sc.C, 9001, offset=8)])
     assert len(more) == 1
     assert len(emitted) == len(ref_out) == 2
+
+
+def test_resume_on_shrunk_mesh(tmp_path):
+    """Checkpoint portability across device counts (ISSUE 13 satellite):
+    a snapshot written by a 2-device meshed supervisor resumes on a
+    1-device mesh AND on no mesh at all — ``restore_processor`` routes
+    the lane re-placement through ``migrate.repartition_state`` — with
+    journal replay and post-resume matching identical to an
+    uninterrupted single-device run."""
+    import jax
+    import pytest
+
+    from kafkastreams_cep_tpu.parallel import key_mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    keys = ("k0", "k1")
+    vals = [sc.A, sc.B, sc.C, sc.A, sc.B]
+
+    def two_lane_batches(off0=0):
+        return [
+            [Record(k, v, 1000 + 10 * i + j, offset=off0 + i)
+             for j, k in enumerate(keys)]
+            for i, v in enumerate(vals)
+        ]
+
+    ck, jr = str(tmp_path / "mesh.ckpt"), str(tmp_path / "mesh.jrnl")
+    sup = Supervisor(
+        sc.strict3(), len(keys), sc.default_config(),
+        checkpoint_path=ck, journal_path=jr, checkpoint_every=3,
+        gc_interval=0, mesh=key_mesh(jax.devices()[:2]),
+    )
+    emitted = []
+    for b in two_lane_batches():
+        emitted += sup.process(b)
+    assert sup.checkpoints >= 1  # the snapshot records mesh_size=2
+    del sup  # crash
+
+    # Each resume target gets the pristine crash aftermath (a resume
+    # mutates the journal/checkpoint it continues from).
+    import shutil
+
+    frozen = {}
+    for p in (ck, jr, ck + ".prev", jr + ".prev"):
+        if os.path.exists(p):
+            frozen[p] = p + ".frozen"
+            shutil.copy(p, p + ".frozen")
+
+    tail = [[Record(k, sc.C, 9000 + j, offset=5) for j, k in enumerate(keys)]]
+    for target_mesh in (key_mesh(jax.devices()[:1]), None):
+        for p in (ck, jr, ck + ".prev", jr + ".prev"):
+            if p in frozen:
+                shutil.copy(frozen[p], p)
+            elif os.path.exists(p):
+                os.remove(p)
+        kw = {} if target_mesh is None else {"mesh": target_mesh}
+        res = Supervisor.resume(
+            sc.strict3(), len(keys), sc.default_config(),
+            checkpoint_path=ck, journal_path=jr, gc_interval=0, **kw,
+        )
+        got = []
+        for b in tail:
+            got += res.process(b)
+        got += res.processor.flush()
+
+        ref = Supervisor(
+            sc.strict3(), len(keys), sc.default_config(),
+            gc_interval=0,
+        )
+        ref_out = []
+        for b in two_lane_batches() + tail:
+            ref_out += ref.process(b)
+        ref_out += ref.processor.flush()
+        assert_same_state(res.processor.state, ref.processor.state)
+        # Pre-crash emissions + post-resume emissions == clean run's.
+        assert len(emitted) + len(got) == len(ref_out)
+        assert not any(res.processor.counters().values())
