@@ -40,7 +40,11 @@ match-sparse trace, default 1; ``CEP_BENCH_TIER_{K,T,CHUNK,REPS}`` size
 it), ``CEP_BENCH_SHARDF`` (shard fault tolerance probes: kill-one-shard
 evacuation latency + degraded throughput, and the hot-key rebalance
 loss contract, default 1 when >= 2 devices; ``CEP_BENCH_SHARDF_{K,B}``
-size them), ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
+size them), ``CEP_BENCH_TENANTS`` (multi-tenant bank sweep: N
+Zipf-overlapping strict-sequence queries on the shared stencil screen vs
+the naive-fused stacked bank, default 1;
+``CEP_BENCH_TENANTS_{N,K,T,REPS,POOL,FUSED_MAX}`` size it),
+``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -1061,6 +1065,190 @@ def bench_bank(n_list, total_lanes, T, reps):
     return results
 
 
+def bench_tenants():
+    """``CEP_BENCH_TENANTS``: multi-tenant bank sweep (ISSUE 14).
+
+    N strict-sequence queries drawn Zipf-style from a small template
+    pool — the SaaS-monitoring shape: thousands of tenants install
+    near-identical alert rules, so prefixes repeat heavily with a long
+    tail of variants.  Every query is pure strict contiguity, so the
+    tenant bank (``parallel/tenantbank.py``) runs the ENTIRE bank on the
+    shared stencil screen: one deduplicated predicate matrix + one
+    vmapped prefix recurrence, no NFA stepping at all.  The baseline is
+    the naive-fused :class:`StackedBankMatcher` — one dispatch, but every
+    query's full NFA machinery on every lane (measured up to
+    ``CEP_BENCH_TENANTS_FUSED_MAX`` queries; beyond that its compile
+    dominates and only the tenant side is recorded).  Matches must be
+    bit-identical and both sides loss-free for the speedup to count —
+    ``tenant_match_parity`` / ``tenant_loss_flags`` join the bench gate.
+    """
+    from kafkastreams_cep_tpu.parallel.stacked import StackedBankMatcher
+    from kafkastreams_cep_tpu.parallel.tenantbank import TenantBankMatcher
+
+    n_list = [
+        int(x)
+        for x in os.environ.get(
+            "CEP_BENCH_TENANTS_N", "100,300,1000"
+        ).split(",")
+    ]
+    K = int(os.environ.get("CEP_BENCH_TENANTS_K", "8"))
+    T = int(os.environ.get("CEP_BENCH_TENANTS_T", "64"))
+    reps = int(os.environ.get("CEP_BENCH_TENANTS_REPS", "3"))
+    pool_n = int(os.environ.get("CEP_BENCH_TENANTS_POOL", "16"))
+    fused_max = int(
+        os.environ.get("CEP_BENCH_TENANTS_FUSED_MAX", "300")
+    )
+    cfg = EngineConfig(
+        max_runs=4, slab_entries=16, slab_preds=4, dewey_depth=8,
+        max_walk=4,
+    )
+    rng = np.random.default_rng(29)
+    # Template pool over a 64-symbol alphabet (the bench_tier shape):
+    # (a, b) prefix pairs; each query appends its own final symbol, so
+    # queries differ while prefixes collapse onto the pool.
+    pool = [
+        (int(a), int(b))
+        for a, b in rng.integers(1, 8, size=(pool_n, 2))
+    ]
+
+    def q(a, b, c):
+        return (
+            Query()
+            .select("pa").where(lambda k, v, ts, st, a=a: v == a)
+            .then()
+            .select("pb").where(lambda k, v, ts, st, b=b: v == b)
+            .then()
+            .select("pc").where(lambda k, v, ts, st, c=c: v == c)
+            .build()
+        )
+
+    # Match-sparse traffic with planted full occurrences so parity is
+    # non-vacuous: codes outside the predicate range almost everywhere.
+    codes = rng.integers(8, 64, size=(K, T)).astype(np.int32)
+    planted = []
+    for i in range(6):
+        k = int(rng.integers(0, K))
+        t = int(rng.integers(0, T - 3))
+        planted.append((k, t))
+    events = None  # built per N after the plants target real queries
+
+    sweep = {}
+    all_parity, all_zero = True, True
+    for N in n_list:
+        # Zipf-heavy template draw: a few templates carry most tenants.
+        z = rng.zipf(1.5, size=N)
+        params = []
+        for i in range(N):
+            a, b = pool[int(z[i] - 1) % pool_n]
+            c = int(rng.integers(1, 8))
+            params.append((a, b, c))
+        ev_codes = codes.copy()
+        for j, (k, t) in enumerate(planted):
+            a, b, c = params[j % len(params)]
+            ev_codes[k, t], ev_codes[k, t + 1], ev_codes[k, t + 2] = (
+                a, b, c,
+            )
+        events = EventBatch(
+            key=jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None], (K, T)),
+            value=jnp.asarray(ev_codes),
+            ts=jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+            off=jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None, :], (K, T)),
+            valid=jnp.ones((K, T), bool),
+        )
+        patterns = [q(*p) for p in params]
+
+        t0 = time.perf_counter()
+        bank = TenantBankMatcher(patterns, K, cfg)
+        st0 = bank.init_state()
+        st, out = bank.scan(st0, events)
+        jax.block_until_ready(out.count)
+        tb_compile = time.perf_counter() - t0
+        tbest = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            st, out = bank.scan(st0, events)
+            jax.block_until_ready(out.count)
+            tbest = min(tbest, time.perf_counter() - t0)
+        total = N * K * T
+        tcount = np.asarray(out.count)
+        tstage, toff = np.asarray(out.stage), np.asarray(out.off)
+        tcounters = bank.counters(st)
+        stats = bank.bank.stats
+        del st0, st, out
+
+        fused_qevps = None
+        speedup = None
+        parity = None
+        zero = all(v == 0 for v in tcounters.values())
+        if N <= fused_max:
+            t0 = time.perf_counter()
+            naive = StackedBankMatcher(patterns, K, cfg)
+            ns0 = naive.init_state()
+            ns, nout = naive.scan(ns0, events)
+            jax.block_until_ready(nout.count)
+            nv_compile = time.perf_counter() - t0
+            nbest = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                ns, nout = naive.scan(ns0, events)
+                jax.block_until_ready(nout.count)
+                nbest = min(nbest, time.perf_counter() - t0)
+            parity = (
+                np.array_equal(tcount, np.asarray(nout.count))
+                and np.array_equal(tstage, np.asarray(nout.stage))
+                and np.array_equal(toff, np.asarray(nout.off))
+            )
+            ncounters = naive.counters(ns)
+            zero = zero and all(v == 0 for v in ncounters.values())
+            fused_qevps = total / nbest
+            speedup = nbest / tbest
+            all_parity &= bool(parity)
+            del naive, ns0, ns, nout
+        all_zero &= bool(zero)
+        log(
+            f"tenants[N={N}] ({N} queries x {K} lanes x {T} events, "
+            f"{stats['prefix_columns_distinct']}/"
+            f"{stats['prefix_columns_total']} distinct prefix columns, "
+            f"dedup {stats['pred_dedup_ratio']:.1f}x): shared-screen "
+            f"{total / tbest / 1e3:.0f}K q-ev/s (compile {tb_compile:.1f}s)"
+            + (
+                f", naive-fused {fused_qevps / 1e3:.0f}K q-ev/s, "
+                f"speedup {speedup:.2f}x, parity={parity}, zero={zero}"
+                if fused_qevps is not None
+                else f", naive-fused skipped (N > {fused_max})"
+            )
+        )
+        sweep[str(N)] = {
+            "shared_qevps": round(total / tbest, 1),
+            "fused_qevps": (
+                round(fused_qevps, 1) if fused_qevps else None
+            ),
+            "speedup": round(speedup, 3) if speedup else None,
+            "match_slots": int((tcount > 0).sum()),
+            "match_parity": parity,
+            "counters_zero": bool(zero),
+            "prefix_columns_distinct": stats["prefix_columns_distinct"],
+            "prefix_columns_total": stats["prefix_columns_total"],
+            "prefix_shared_hit_rate": round(
+                float(stats["prefix_shared_hit_rate"]), 4
+            ),
+            "pred_dedup_ratio": round(
+                float(stats["pred_dedup_ratio"]), 3
+            ),
+        }
+    return {
+        "k": K, "t": T, "pool": pool_n,
+        "sweep": sweep,
+        # The gate flags: parity/loss over every N that ran the fused
+        # baseline (bench_gate flattens these to tenant_*).
+        "match_parity": bool(all_parity),
+        "counters_zero": bool(all_zero),
+    }
+
+
 def bench_sharded_folds(K, T, reps):
     """BASELINE.json config 4: WITHIN window + fold(avg,volume) predicates
     over ~1M key lanes, sharded over the available mesh (one chip here;
@@ -1694,6 +1882,7 @@ def main():
     proc_phases = {}
     ooo = {}
     tier = {}
+    tenants = {}
 
     def _shard_fault_block():
         # Nested under ``resilience`` so the JSON groups every
@@ -1714,6 +1903,14 @@ def main():
                 lambda: tier.update(
                     bench_tier()
                     if os.environ.get("CEP_BENCH_TIER", "1") == "1"
+                    else {}
+                ),
+            ),
+            (
+                "tenants",
+                lambda: tenants.update(
+                    bench_tenants()
+                    if os.environ.get("CEP_BENCH_TENANTS", "1") == "1"
                     else {}
                 ),
             ),
@@ -1874,6 +2071,12 @@ def main():
                 # screened-event fraction, NFA dispatch fraction, match
                 # parity (None when extras skipped or CEP_BENCH_TIER=0).
                 "tier": tier or None,
+                # Multi-tenant bank sweep (ISSUE 14): N Zipf-overlapping
+                # queries, shared stencil screen + deduplicated predicate
+                # matrix vs the naive-fused stacked bank — per-N q-ev/s,
+                # speedup, match parity, loss flags (None when extras
+                # skipped or CEP_BENCH_TENANTS=0).
+                "tenants": tenants or None,
             }
         ),
         flush=True,

@@ -56,6 +56,8 @@ FLAG_METRICS = (
     "tier_counters_zero",
     "shard_evac_parity",
     "shard_rebalance_lossfree",
+    "tenant_match_parity",
+    "tenant_loss_flags",
 )
 #: Ratio metrics guarded like rates (0..1, higher is better).
 RATIO_METRICS = ("recall_sampled",)
@@ -87,6 +89,13 @@ def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         # keys: the exactly-once-under-fault flags join the flag guard.
         flat["shard_evac_parity"] = shard.get("evac_parity")
         flat["shard_rebalance_lossfree"] = shard.get("rebalance_lossfree")
+    tenants = parsed.get("tenants")
+    if isinstance(tenants, dict):
+        # Nested tenants block (BENCH_r07+) -> flat ``tenant_*`` keys:
+        # the multi-tenant bank's bit-exactness vs the naive-fused bank
+        # and its all-counters-zero flag may never regress true -> false.
+        flat["tenant_match_parity"] = tenants.get("match_parity")
+        flat["tenant_loss_flags"] = tenants.get("counters_zero")
     for k in FLAG_METRICS:
         v = flat.get(k)
         if isinstance(v, bool):

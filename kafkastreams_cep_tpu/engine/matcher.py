@@ -491,6 +491,7 @@ class StepPhases(NamedTuple):
     out_rows: int
     max_walk: int
     hot_entries: int
+    pred_stats: Any = None  # merged-dispatch dedup stats (multitenant)
 
 
 class _ChainRecord(NamedTuple):
@@ -571,15 +572,25 @@ def _build_step(tables, cfg: EngineConfig):
     # 0 (zero-size arrays, zero device work) when not.
     S_AT = tables.num_stages if cfg.stage_attribution else 0
 
-    # Per-query predicate-id offsets into the merged dispatch list.
-    pred_base = np.cumsum([0] + [len(t.predicates) for t in tlist])[:-1]
+    # Merged predicate dispatch table: the union of all queries'
+    # predicates deduplicated and split into an event-level half (proven
+    # independent of per-run fold state — evaluated once per event, the
+    # dense predicate-matrix rows) and a run-level half (evaluated per
+    # run under the owner query's decode).  compiler/multitenant.py owns
+    # the proofs; per-query table entries remap into the merged ids.
+    from kafkastreams_cep_tpu.compiler.multitenant import (
+        plan_step_predicates,
+    )
+
+    pred_plan = plan_step_predicates(tlist)
+    _remaps = pred_plan.remaps
 
     def stk(get, offset=False):
         rows = []
         for q, t in enumerate(tlist):
             a = np.asarray(get(t))
-            if offset and Q > 1:
-                a = np.where(a >= 0, a + pred_base[q], a)
+            if offset and len(_remaps[q]):
+                a = np.where(a >= 0, _remaps[q][np.maximum(a, 0)], a)
             rows.append(a)
         return jnp.asarray(np.stack(rows))  # [Q, S]
 
@@ -648,30 +659,50 @@ def _build_step(tables, cfg: EngineConfig):
     def inits_of(qid):
         return inits[0] if Q == 1 else get_at(inits, qid)
 
-    def eval_preds(key, value, ts, agg_row):
-        """ALL queries' predicates against the lane's fold state — each
-        query decodes the shared agg row through its own names/dtypes, and
-        its table entries index the merged list via ``pred_base``.
+    G0, G1 = pred_plan.num_event, pred_plan.num_run
 
-        Stacked-bank contract: every query's predicates run on every lane,
-        so a lane's agg row is also decoded under *other* queries' dtype
-        conventions; those values are never selected (``pred_base``
-        offsetting keeps each lane on its own query's predicate ids) but
-        the evaluation itself happens.  Predicates must therefore be pure
-        array functions — no side effects, no host callbacks, total over
-        garbage inputs.  jit tracing already enforces the first two; NaN-
-        or overflow-sensitive user code must tolerate off-query rows."""
+    def eval_preds_event(key, value, ts):
+        """The event-level half of the merged dispatch table: predicates
+        proven independent of per-run fold state (``compiler/multitenant.
+        reads_states``), deduplicated across stacked queries, evaluated
+        ONCE per event instead of once per run per query.  The ``states``
+        argument is provably never observed; an empty view is passed."""
+        empty = ArrayStates({})
+        return jnp.stack(
+            [
+                _as_bool(e.pred(key, value, ts, empty))
+                for e in pred_plan.event_entries
+            ]
+        )
+
+    def eval_preds_run(key, value, ts, agg_row):
+        """The run-level half: each fold-state-reading predicate against
+        the lane's agg row decoded through its OWNER query's
+        names/dtypes.
+
+        Stacked-bank contract: a lane's agg row is also decoded under
+        *other* queries' dtype conventions (every run-level predicate
+        evaluates on every lane); those values are never selected — the
+        per-query remap keeps each lane on its own query's predicate ids
+        — but the evaluation itself happens.  Predicates must therefore
+        be pure array functions — no side effects, no host callbacks,
+        total over garbage inputs.  jit tracing already enforces the
+        first two; NaN- or overflow-sensitive user code must tolerate
+        off-query rows."""
+        env: Dict[int, ArrayStates] = {}
         vals = []
-        for q, t in enumerate(tlist):
-            states = ArrayStates(
-                {
-                    n: dec(agg_row[i], is_float_q[q][i])
-                    for i, n in enumerate(t.state_names)
-                }
-            )
-            vals.extend(
-                _as_bool(pr(key, value, ts, states)) for pr in t.predicates
-            )
+        for e in pred_plan.run_entries:
+            states = env.get(e.owner)
+            if states is None:
+                t = tlist[e.owner]
+                states = ArrayStates(
+                    {
+                        n: dec(agg_row[i], is_float_q[e.owner][i])
+                        for i, n in enumerate(t.state_names)
+                    }
+                )
+                env[e.owner] = states
+            vals.append(_as_bool(e.pred(key, value, ts, states)))
         return jnp.stack(vals)
 
     # All traced-index reads below go through one-hot selects (ops/onehot)
@@ -877,7 +908,28 @@ def _build_step(tables, cfg: EngineConfig):
             qid = jnp.zeros((), i32)
         key, value = ev.key, ev.value
         ts, off = jnp.asarray(ev.ts, i32), jnp.asarray(ev.off, i32)
-        preds = jax.vmap(lambda a: eval_preds(key, value, ts, a))(state.agg)
+        # The merged [R, G] predicate frame: the event-level block is one
+        # evaluation broadcast over runs; only state-reading predicates
+        # pay the per-run vmap.
+        parts = []
+        if G0:
+            parts.append(
+                jnp.broadcast_to(
+                    eval_preds_event(key, value, ts), (R, G0)
+                )
+            )
+        if G1:
+            parts.append(
+                jax.vmap(lambda a: eval_preds_run(key, value, ts, a))(
+                    state.agg
+                )
+            )
+        if len(parts) == 2:
+            preds = jnp.concatenate(parts, axis=-1)
+        elif parts:
+            preds = parts[0]
+        else:
+            preds = jnp.zeros((R, 0), jnp.bool_)
         return jax.vmap(
             chain_one,
             in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None, None,
@@ -1276,6 +1328,7 @@ def _build_step(tables, cfg: EngineConfig):
         out_rows=R,
         max_walk=W,
         hot_entries=EH,
+        pred_stats=dict(pred_plan.stats),
     )
     return step, init_state, phases
 
@@ -1344,6 +1397,27 @@ def build_drain(cfg: EngineConfig):
     return drain
 
 
+def _build_programs(tables: TransitionTables, cfg: EngineConfig):
+    """Build the full program bundle one :class:`TPUMatcher` needs.
+
+    Returned as a tuple so :mod:`utils.tracecache` can share it across
+    matcher instances with structurally identical (tables, config): the
+    jitted callables carry their trace/compile caches with them, so a
+    cache hit skips both the Python re-trace and the XLA compile.
+    """
+    step, init_state, phases = _build_step(tables, cfg)
+
+    def scan(state: EngineState, events: EventBatch):
+        """Run a [T]-stacked batch of events; returns [T]-stacked outputs."""
+        return jax.lax.scan(step, state, events)
+
+    drain_fn = build_drain(cfg)
+    return (
+        step, init_state, phases, jax.jit(step), jax.jit(scan), drain_fn,
+        jax.jit(drain_fn),
+    )
+
+
 class TPUMatcher:
     """A compiled array matcher for one pattern.
 
@@ -1368,14 +1442,29 @@ class TPUMatcher:
             self.tables.num_stages, self.tables.names,
             self.tables.max_hops, self.config,
         )
-        step, init_state, phases = _build_step(self.tables, self.config)
-        self._step_fn = step
-        self._init_fn = init_state
-        self._phases = phases
-        self.step = jax.jit(step)
-        self.scan = jax.jit(self._scan)
-        self._drain_fn = build_drain(self.config)
-        self.drain = jax.jit(self._drain_fn)
+        # The traced/jitted programs are structural functions of
+        # (tables, config): identical fingerprints share one build —
+        # including the jit caches behind ``step``/``scan``/``drain`` —
+        # so re-instantiating a matcher for an already-compiled pattern
+        # (tests, evacuation restores, supervisor recovery) costs a dict
+        # lookup instead of a 2-5s re-trace.
+        from kafkastreams_cep_tpu.compiler.multitenant import tables_key
+        from kafkastreams_cep_tpu.utils import tracecache
+
+        tkey = tables_key(self.tables)
+        cache_key = (
+            None
+            if tkey is None
+            else (tkey, dataclasses.astuple(self.config))
+        )
+        (
+            self._step_fn, self._init_fn, self._phases, self.step,
+            self.scan, self._drain_fn, self.drain,
+        ) = tracecache.lookup(
+            "engine.programs",
+            cache_key,
+            lambda: _build_programs(self.tables, self.config),
+        )
 
     @property
     def names(self) -> List[str]:

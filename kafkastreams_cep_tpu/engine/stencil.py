@@ -39,6 +39,19 @@ from kafkastreams_cep_tpu.compiler.tables import (
 from kafkastreams_cep_tpu.engine.matcher import ArrayStates, EventBatch
 
 
+def _cached_scan_jit(namespace, tables, shape_key, scan_fn):
+    """Jit ``scan_fn`` through the process trace cache keyed by the
+    pattern fingerprint + lane/prefix shape — stencil matchers for the
+    same pattern (tests, tenant banks instantiating per-query screens,
+    recovery rebuilds) share one traced program."""
+    from kafkastreams_cep_tpu.compiler.multitenant import tables_key
+    from kafkastreams_cep_tpu.utils import tracecache
+
+    tkey = tables_key(tables)
+    key = None if tkey is None else (tkey,) + tuple(shape_key)
+    return tracecache.lookup(namespace, key, lambda: jax.jit(scan_fn))
+
+
 class StencilState(NamedTuple):
     """Carry across micro-batches: the trailing ``n-1`` valid events."""
 
@@ -82,7 +95,9 @@ class StencilMatcher:
         self._preds = [
             self.tables.predicates[self.tables.consume_pred[i]] for i in range(n)
         ]
-        self.scan = jax.jit(self._scan)
+        self.scan = _cached_scan_jit(
+            "stencil.scan", self.tables, (self.num_lanes,), self._scan
+        )
 
     def init_state(self) -> StencilState:
         K, n = self.num_lanes, self.n
@@ -263,7 +278,10 @@ class StencilPrefix:
                 )
             }
         )
-        self.scan = jax.jit(self._scan)
+        self.scan = _cached_scan_jit(
+            "stencil.prefix_scan", self.tables,
+            (self.num_lanes, self.p), self._scan,
+        )
 
     def init_carry(self) -> PrefixCarry:
         K, p = self.num_lanes, self.p

@@ -59,7 +59,7 @@ from kafkastreams_cep_tpu.engine.matcher import (
 )
 from kafkastreams_cep_tpu.engine.stencil import PrefixCarry, PromoOutput
 from kafkastreams_cep_tpu.ops import slab as slab_mod
-from kafkastreams_cep_tpu.ops.onehot import put_at
+from kafkastreams_cep_tpu.ops.onehot import get_at, put_at
 
 
 class TieredState(NamedTuple):
@@ -189,6 +189,106 @@ def build_promote(tables, cfg: EngineConfig, prefix_len: int):
     return promote
 
 
+def build_promote_stacked(tlist, cfg: EngineConfig, prefix_len: int):
+    """The stacked-bank analog of :func:`build_promote`: one promotion
+    step shared by a group of same-shape queries with equal prefix
+    length, lane-dispatched by ``qid`` exactly like the stacked engine
+    step (``engine/matcher.py: _build_step`` stacked mode).
+
+    Per lane, the replayed chain writes and the appended suffix run use
+    the lane's *own* query's stage identities, eval position, and fold
+    inits (one-hot selected, ``ops/onehot.py: get_at``); everything else
+    is the single-query promotion verbatim, so vmapping over a ``[Q*K]``
+    lane axis with per-lane ``qid`` promotes each lane bit-identically
+    to its query's own :func:`build_promote`.
+    """
+    p = int(prefix_len)
+    R, D = cfg.max_runs, cfg.dewey_depth
+    EH = cfg.slab_hot_entries
+    if not 0 < p <= D:
+        raise ValueError(
+            f"prefix_len={p} must be in 1..dewey_depth={D} (the promoted "
+            "version carries one digit per prefix stage)"
+        )
+    idents_q = np.asarray(
+        [[int(t.ident[j]) for j in range(p)] for t in tlist], np.int32
+    )  # [Q, p]
+    eval_pos_q = np.asarray(
+        [int(t.consume_target[p - 1]) for t in tlist], np.int32
+    )
+    NS = max(max(t.num_states for t in tlist), 1)
+
+    def _enc(x, dt):
+        if dt == "float32":
+            return int(np.float32(x).view(np.int32))
+        return int(np.int32(x))
+
+    inits_q = np.asarray(
+        [
+            [
+                _enc(x, d)
+                for x, d in zip(t.state_inits, t.state_dtypes)
+            ]
+            + [0] * (NS - t.num_states)
+            for t in tlist
+        ],
+        np.int32,
+    )  # [Q, NS]
+    idents_dev = jnp.asarray(idents_q)
+    eval_pos_dev = jnp.asarray(eval_pos_q)
+    inits_dev = jnp.asarray(inits_q)
+
+    def promote(
+        state: EngineState, fire, offs, anchor_ts, sver, qid
+    ) -> Tuple[EngineState, jnp.ndarray]:
+        i32 = jnp.int32
+        fire = jnp.asarray(fire)
+        ident_row = get_at(idents_dev, qid)  # [p]
+        cnt = jnp.sum(state.alive.astype(i32))
+        fit = fire & (cnt < R)
+
+        ver = jnp.zeros((D,), i32).at[0].set(jnp.asarray(sver, i32))
+        slab = state.slab
+        slab = slab_mod.put_first(
+            slab, ident_row[0], offs[..., 0], ver, jnp.int32(1),
+            enable=fit, hot_entries=EH,
+        )
+        for j in range(1, p):
+            slab = slab_mod.put(
+                slab, ident_row[j], offs[..., j],
+                ident_row[j - 1], offs[..., j - 1],
+                ver, jnp.int32(j + 1), enable=fit, hot_entries=EH,
+            )
+
+        row = cnt  # live runs are a contiguous prefix (queue compaction)
+        state = state._replace(
+            alive=put_at(state.alive, row, True, enable=fit),
+            id_pos=put_at(
+                state.id_pos, row, ident_row[p - 1], enable=fit
+            ),
+            eval_pos=put_at(
+                state.eval_pos, row, get_at(eval_pos_dev, qid), enable=fit
+            ),
+            ver=put_at(state.ver, row, ver[None, :], enable=fit),
+            vlen=put_at(state.vlen, row, jnp.int32(p), enable=fit),
+            event_off=put_at(
+                state.event_off, row, offs[..., p - 1], enable=fit
+            ),
+            start_ts=put_at(
+                state.start_ts, row, jnp.asarray(anchor_ts, i32), enable=fit
+            ),
+            branching=put_at(state.branching, row, False, enable=fit),
+            agg=put_at(
+                state.agg, row, get_at(inits_dev, qid)[None, :], enable=fit
+            ),
+            slab=slab,
+            run_drops=state.run_drops + jnp.where(fire & ~fit, 1, 0),
+        )
+        return state, jnp.where(fit, 1, 0).astype(i32)
+
+    return promote
+
+
 def stencil_step_output(tables, cfg: EngineConfig, prefix_len: int):
     """Compile the pure-stencil tier's output synthesizer: prefix
     completions rendered as the ``[K, T, R, W]`` :class:`StepOutput` grid
@@ -228,5 +328,53 @@ def stencil_step_output(tables, cfg: EngineConfig, prefix_len: int):
             jnp.where(fire, p, 0)
         )
         return StepOutput(stage=stage, off=off, count=count)
+
+    return synth
+
+
+def stencil_step_output_stacked(tlist, cfg: EngineConfig, prefix_len: int):
+    """:func:`stencil_step_output` for a group of pure-stencil queries
+    with equal prefix length: one synthesizer over ``[N]``-stacked
+    :class:`PromoOutput` leaves, vmapped with each member's reversed
+    identity row as a per-member input.  The per-member slice is the
+    single-query synth verbatim."""
+    p = int(prefix_len)
+    R, W = cfg.max_runs, cfg.max_walk
+    if p > W:
+        raise ValueError(
+            f"pure-stencil tier needs prefix_len={p} <= max_walk={W}"
+        )
+    rev_idents = jnp.asarray(
+        [
+            [int(t.ident[j]) for j in range(p - 1, -1, -1)]
+            for t in tlist
+        ],
+        jnp.int32,
+    )  # [N, p]
+
+    def synth_one(promo: PromoOutput, rev_ident) -> StepOutput:
+        i32 = jnp.int32
+        K, T = promo.fire.shape
+        fire = promo.fire
+        stage_rows = jnp.where(
+            fire[..., None], rev_ident[None, None, :], -1
+        )  # [K, T, p]
+        off_rows = jnp.where(fire[..., None], promo.offs[..., ::-1], -1)
+        pad = jnp.full((K, T, W - p), -1, i32)
+        stage = jnp.full((K, T, R, W), -1, i32)
+        off = jnp.full((K, T, R, W), -1, i32)
+        stage = stage.at[:, :, 0, :].set(
+            jnp.concatenate([stage_rows, pad], axis=-1)
+        )
+        off = off.at[:, :, 0, :].set(
+            jnp.concatenate([off_rows, pad], axis=-1)
+        )
+        count = jnp.zeros((K, T, R), i32).at[:, :, 0].set(
+            jnp.where(fire, p, 0)
+        )
+        return StepOutput(stage=stage, off=off, count=count)
+
+    def synth(promo: PromoOutput) -> StepOutput:
+        return jax.vmap(synth_one)(promo, rev_idents)
 
     return synth

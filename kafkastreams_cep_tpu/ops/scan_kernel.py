@@ -140,7 +140,26 @@ def build_scan(tables, config: EngineConfig):
     window_ms = np.asarray(tables.window_ms.astype(np.int64))
     final_pos = int(tables.final_pos)
     begin_pos = int(tables.begin_pos)
-    predicates = list(tables.predicates)
+    # Same predicate-dedup pass as the jnp path (_build_step): distinct
+    # predicates evaluate once per event, shared across every edge that
+    # references them; provably state-independent ones get an empty
+    # states env so their kernel code carries no agg dependence.
+    from kafkastreams_cep_tpu.compiler.multitenant import (
+        plan_step_predicates,
+    )
+
+    pred_plan = plan_step_predicates([tables])
+    pred_entries = list(pred_plan.event_entries) + list(
+        pred_plan.run_entries
+    )
+    _remap = pred_plan.remaps[0]
+    if len(_remap):
+        def _remap_ids(a):
+            return np.where(a >= 0, _remap[np.maximum(a, 0)], a)
+
+        consume_pred = _remap_ids(consume_pred)
+        ignore_pred = _remap_ids(ignore_pred)
+        proceed_pred = _remap_ids(proceed_pred)
     is_float = [d == "float32" for d in tables.state_dtypes] + [False] * (
         NS - tables.num_states
     )
@@ -284,11 +303,19 @@ def build_scan(tables, config: EngineConfig):
         value = jax.tree_util.tree_unflatten(
             value_treedef, [l[:][0] for l in ev_leaves]
         )
+        empty_states = ArrayStates({})
         preds = [
             jnp.broadcast_to(
-                jnp.asarray(pr(key, value, ts, states), jnp.bool_), (R, L)
+                jnp.asarray(
+                    e.pred(
+                        key, value, ts,
+                        states if e.stateful else empty_states,
+                    ),
+                    jnp.bool_,
+                ),
+                (R, L),
             )
-            for pr in predicates
+            for e in pred_entries
         ]
 
         def pv(pid):

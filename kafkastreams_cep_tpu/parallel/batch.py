@@ -318,6 +318,23 @@ class BatchMatcher:
         )
         self.uses_walk_kernel = use_kernel
         self._kernel_interpret = interpret
+        # Like TPUMatcher, the lane-lifted jitted programs are structural
+        # functions of (tables, config, kernel mode): share them across
+        # instances so re-building a batch matcher for a known pattern
+        # skips the vmap/scan re-trace (utils/tracecache.py).  The lane
+        # count K is deliberately NOT in the key — vmap programs retrace
+        # per input shape inside jit anyway, so one cached callable
+        # serves every K in the same kernel-feasibility class.
+        import dataclasses as _dc
+
+        from kafkastreams_cep_tpu.compiler.multitenant import tables_key
+
+        _tk = tables_key(self.matcher.tables)
+        self._cache_key = (
+            None
+            if _tk is None
+            else (_tk, _dc.astuple(self.matcher.config))
+        )
         if use_kernel:
             logger.info(
                 "batch matcher: fused walk kernel enabled (%d lanes%s)",
@@ -325,9 +342,11 @@ class BatchMatcher:
             )
             self._step_fn = kernel_lane_step(self.matcher._phases, interpret)
             self._scan_fn = kernel_lane_scan(self._step_fn)
+            self._mode_tag = ("kernel", interpret)
         else:
             self._step_fn = lane_step(self.matcher._step_fn)
             self._scan_fn = lane_scan(self.matcher._step_fn)
+            self._mode_tag = ("jnp",)
         # Whole-scan fused kernel (ops/scan_kernel.py): the entire event
         # loop in one Pallas program, state resident in VMEM across T.
         # Opt-in (CEP_SCAN_KERNEL=1, or =interpret for CPU testing):
@@ -348,31 +367,60 @@ class BatchMatcher:
                     scan_mode, self.num_lanes, scan_kernel.LANE_BLOCK,
                 )
             else:
-                full = scan_kernel.build_scan(
-                    self.matcher.tables, self.matcher.config
+                def _build_full(scan_mode=scan_mode):
+                    full = scan_kernel.build_scan(
+                        self.matcher.tables, self.matcher.config
+                    )
+                    full.interpret = scan_mode == "interpret"
+                    return jax.jit(full)
+
+                jitted_full = self._cached(
+                    "batch.scan_kernel", ("scan", scan_mode), _build_full
                 )
-                full.interpret = scan_mode == "interpret"
-                self._scan_fn = self._with_fallback(full)
+                self._scan_fn = self._with_fallback(jitted_full)
                 self.uses_scan_kernel = True
                 logger.info("batch matcher: whole-scan kernel enabled")
-        self.step = jax.jit(self._step_fn)
-        self.scan = jax.jit(self._scan_fn) if not self.uses_scan_kernel \
-            else self._scan_fn
+        self.step = self._cached(
+            "batch.step", self._mode_tag, lambda: jax.jit(self._step_fn)
+        )
+        self.scan = (
+            self._scan_fn
+            if self.uses_scan_kernel
+            else self._cached(
+                "batch.scan", self._mode_tag,
+                lambda: jax.jit(self._scan_fn),
+            )
+        )
 
-    def _with_fallback(self, full_scan):
+    def _cached(self, namespace: str, tag, build):
+        """Jitted-program lookup in the process trace cache, keyed by this
+        matcher's (tables fingerprint, config) plus ``tag`` — unkeyable
+        patterns build uncached."""
+        from kafkastreams_cep_tpu.utils import tracecache
+
+        key = None if self._cache_key is None else self._cache_key + (tag,)
+        return tracecache.lookup(namespace, key, build)
+
+    def _with_fallback(self, jitted_full_scan):
         """:func:`guarded_scan_fallback` over this matcher's per-step
         path — see the helper for the failure-classification policy."""
 
         def make_slow():
             if self.uses_walk_kernel:
-                return jax.jit(kernel_lane_scan(self._step_fn))
-            return jax.jit(lane_scan(self.matcher._step_fn))
+                return self._cached(
+                    "batch.scan", self._mode_tag,
+                    lambda: jax.jit(kernel_lane_scan(self._step_fn)),
+                )
+            return self._cached(
+                "batch.scan", self._mode_tag,
+                lambda: jax.jit(lane_scan(self.matcher._step_fn)),
+            )
 
         def on_fallback():
             self.uses_scan_kernel = False
 
         return guarded_scan_fallback(
-            jax.jit(full_scan), make_slow, on_fallback
+            jitted_full_scan, make_slow, on_fallback
         )
 
     @property
@@ -392,9 +440,18 @@ class BatchMatcher:
 
     @functools.cached_property
     def _sweep_jit(self):
+        from kafkastreams_cep_tpu.utils import tracecache
+
         depth = self.matcher.config.max_walk
         do_renorm = self.matcher.config.renorm_versions
-        return jax.jit(lambda state: sweep_lanes(state, depth, do_renorm))
+        # Table-free: one sweep program serves every pattern at the same
+        # (max_walk, renorm) — key on just those, not the pattern.
+        return tracecache.lookup(
+            "batch.sweep", (depth, do_renorm),
+            lambda: jax.jit(
+                lambda state: sweep_lanes(state, depth, do_renorm)
+            ),
+        )
 
     def drain(self, state: EngineState):
         """Materialize every pending lazy-extraction handle in one batched
@@ -406,9 +463,21 @@ class BatchMatcher:
 
     @functools.cached_property
     def _drain_jit(self):
+        import dataclasses as _dc
+
+        from kafkastreams_cep_tpu.utils import tracecache
+
         cfg = self.matcher.config
+        # The drain program is table-free (build_drain) — key on config
+        # plus kernel mode only, shared across all patterns.
+        dkey = (_dc.astuple(cfg), self.uses_walk_kernel,
+                self._kernel_interpret)
         if not self.uses_walk_kernel:
-            return jax.jit(jax.vmap(self.matcher._drain_fn))
+            drain_fn = self.matcher._drain_fn
+            return tracecache.lookup(
+                "batch.drain", dkey,
+                lambda: jax.jit(jax.vmap(drain_fn)),
+            )
         from kafkastreams_cep_tpu.ops.walk_kernel import walk_pass_kernel
 
         HB, W, EH, D = (
@@ -461,7 +530,9 @@ class BatchMatcher:
             )
             return state, out
 
-        return jax.jit(drain)
+        return tracecache.lookup(
+            "batch.drain", dkey, lambda: jax.jit(drain)
+        )
 
     def counters(self, state: EngineState) -> Dict[str, int]:
         """Aggregate overflow/drop counters summed over all lanes."""

@@ -10,6 +10,15 @@ one query), their tables stack on a leading query axis and a per-lane
 as ``N*K`` lanes of one program — BASELINE.json config 4's "multi-pattern
 NFA bank, batched".
 
+Identical predicates across the stack (shared stages of parameterized
+variants) are interned by bytecode structure before tracing
+(``compiler/multitenant.py: plan_step_predicates``), so the fused step
+evaluates each distinct predicate once per event rather than once per
+query — ``StackedBankMatcher.pred_stats`` reports the measured dedup
+ratio.  For banks with shared strict-contiguity *prefixes*, the
+multi-tenant matcher (``parallel/tenantbank.py``) goes further and
+screens the whole bank with one stencil pass.
+
 Use :func:`stackable` to test compatibility and fall back to
 ``runtime/bank.py: CEPBank``'s per-query loop otherwise.
 """
@@ -82,6 +91,21 @@ class StackedBankMatcher:
         self._step_fn = step
         self._init_fn = init_state
         self._phases = phases
+        # _build_step interns predicates by bytecode identity across the
+        # whole stack (compiler/multitenant.py: plan_step_predicates):
+        # a bank of N parameterized variants of one query evaluates each
+        # *distinct* predicate once per event instead of N times per lane.
+        self.pred_stats = dict(phases.pred_stats or {})
+        if self.pred_stats:
+            logger.info(
+                "stacked bank predicate dedup: %d -> %d distinct "
+                "(%d event-level, %d run-level; ratio %.2f)",
+                self.pred_stats.get("total_predicates", 0),
+                self.pred_stats.get("distinct_predicates", 0),
+                self.pred_stats.get("event_level", 0),
+                self.pred_stats.get("run_level", 0),
+                self.pred_stats.get("dedup_ratio", 1.0),
+            )
         qids = jnp.repeat(
             jnp.arange(self.Q, dtype=jnp.int32), self.K
         )  # [Q*K]

@@ -1,0 +1,734 @@
+"""Multi-tenant query bank: ONE shared stencil screen for N queries.
+
+The stacked bank (``parallel/stacked.py``) fuses N same-shape queries
+into one dispatch but still pays every query's predicate work on every
+lane.  This matcher executes the bank *plan*
+(``compiler/multitenant.py: plan_bank``) instead:
+
+* **One predicate matrix.**  Every distinct prefix predicate in the bank
+  is one column of a dense ``[K, T, C]`` boolean matrix
+  (``engine/predmatrix.py``) evaluated ONCE per batch — a predicate
+  shared by 100 queries costs what it costs one query.
+* **One stencil frontier.**  Each non-NFA query's strict-contiguity
+  prefix is a path of column ids; all prefixes of equal length advance
+  as one vmapped stencil recurrence over the matrix gather
+  (``predmatrix.bank_prefix_scan``).  Pure-stencil queries are *done*
+  there — their match grids are synthesized without ever touching an
+  engine (``engine/tiered.py: stencil_step_output_stacked``).
+* **Grouped residuals.**  Hybrid queries' NFA suffixes stack into
+  same-shape engine groups (``engine/matcher.py: _build_step`` stacked
+  mode) fed by a stacked promotion step
+  (``engine/tiered.py: build_promote_stacked``); whole-NFA queries stack
+  into seeded groups.  Each hybrid group is skip-gated exactly like the
+  single-query tiered matcher — one scalar ``device_get`` for ALL
+  groups' gates per scan.
+
+Parity: per query, matches, emission order, and loss counters are
+bit-identical to that query running alone on its own serial matcher
+(tests/test_multitenant.py) — the screen math is ``StencilPrefix._scan``
+verbatim under a query vmap, the promotions replay ``build_promote``
+with one-hot selected per-query constants, and group skip-gating only
+ever elides steps that change nothing but ``step_seq``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafkastreams_cep_tpu.compiler.multitenant import (
+    BankPlan,
+    bank_key,
+    plan_bank,
+)
+from kafkastreams_cep_tpu.compiler.tiering import TIER_HYBRID, TIER_NFA
+from kafkastreams_cep_tpu.engine.matcher import (
+    COUNTER_NAMES,
+    HOT_COUNTER_NAMES,
+    TIER_COUNTER_NAMES,
+    WALK_COUNTER_NAMES,
+    DrainOutput,
+    EngineConfig,
+    EngineState,
+    EventBatch,
+    StepOutput,
+    _build_step,
+    build_drain,
+    counter_values,
+    hot_counter_values,
+    per_lane_counter_arrays,
+    walk_counter_values,
+)
+from kafkastreams_cep_tpu.engine.predmatrix import (
+    bank_prefix_scan,
+    build_matrix,
+    group_bools,
+    init_carries,
+)
+from kafkastreams_cep_tpu.engine.stencil import PrefixCarry
+from kafkastreams_cep_tpu.engine.tiered import (
+    build_promote_stacked,
+    seedless_init,
+    stencil_step_output_stacked,
+)
+from kafkastreams_cep_tpu.parallel.batch import (
+    _select_walk_kernel,
+    kernel_lane_scan,
+    kernel_lane_step,
+    sweep_lanes,
+)
+from kafkastreams_cep_tpu.parallel.tiered import _bump_engine_jit
+from kafkastreams_cep_tpu.utils import tracecache
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.tenantbank")
+
+
+class TenantState(NamedTuple):
+    """Whole-bank matcher state: one stacked ``[Qg*K]`` engine state per
+    residual group plus one ``[Nq, K]`` stencil carry per prefix-length
+    group.  A pytree (tuples of NamedTuples), so checkpointing, device
+    placement, and ``runtime/migrate.py: widen_state`` compose."""
+
+    engine: Tuple[EngineState, ...]
+    carry: Tuple[PrefixCarry, ...]
+
+
+@dataclasses.dataclass
+class _PrefixGroup:
+    """All non-NFA queries whose prefixes have the same length ``p``:
+    one ``[Nq, K]`` carry, one vmapped recurrence over the matrix."""
+
+    p: int
+    qids: List[int]  # original query ids, member order
+    sigs: np.ndarray  # [Nq, p] column ids
+    stencil_rows: List[int]  # member rows that are pure-stencil
+    stencil_qids: List[int]
+
+
+@dataclasses.dataclass
+class _EngineGroup:
+    """One stacked residual dispatch: same-shape queries, one program."""
+
+    kind: str  # "hybrid" | "nfa"
+    qids: List[int]
+    tlist: list
+    p: int  # shared prefix length (0 for nfa)
+    pg: Optional[int]  # owning prefix-group index (hybrid only)
+    rows: List[int]  # member rows inside the prefix group (hybrid only)
+    programs: tuple = ()  # (step, init_fn, phases, scan_jit, drain_jit)
+
+    @property
+    def Q(self) -> int:
+        return len(self.qids)
+
+
+def _stack_sig(t) -> tuple:
+    """The same-shape key ``compiler/tables.py: stackable`` tests."""
+    return (
+        t.num_stages, t.max_hops, int(t.begin_pos), int(t.final_pos),
+    )
+
+
+def _build_group_programs(
+    group: _EngineGroup, cfg: EngineConfig, K: int
+):
+    """Step + scan + drain programs for one engine group.
+
+    The hybrid scan replicates the ``[K, T]`` events across members
+    inside the jit, gathers the group's promotion rows out of the owning
+    prefix group's ``[Np, K, T, ...]`` tensor (static member rows), and
+    runs the step-then-promote schedule of the single-query tiered
+    matcher per lane — qid-dispatched, so each lane is its own query.
+    """
+    Qg = group.Q
+    L = Qg * K
+    step, init_fn, phases = _build_step(group.tlist, cfg)
+    qids = jnp.repeat(jnp.arange(Qg, dtype=jnp.int32), K)
+    use_kernel, interpret = _select_walk_kernel(cfg, L)
+
+    def rep(events):
+        return jax.tree_util.tree_map(
+            lambda x: jnp.concatenate([x] * Qg, axis=0), events
+        )
+
+    def unstack(out):
+        return jax.tree_util.tree_map(
+            lambda x: x.reshape((Qg, K) + x.shape[1:]), out
+        )
+
+    if group.kind == "nfa":
+        if use_kernel:
+            bstep = kernel_lane_step(phases, interpret, qids=qids)
+            inner_scan = kernel_lane_scan(bstep)
+        else:
+
+            def inner_scan(state, events):
+                return jax.vmap(
+                    lambda s, e, q: jax.lax.scan(
+                        lambda c, x: step(c, x, q), s, e
+                    )
+                )(state, events, qids)
+
+        def scan(state: EngineState, events: EventBatch):
+            state, out = inner_scan(state, rep(events))
+            return state, unstack(out)
+
+        scan_jit = jax.jit(scan)
+    else:
+        if use_kernel:
+            base_step = kernel_lane_step(phases, interpret, qids=qids)
+        else:
+
+            def base_step(s, ev):
+                return jax.vmap(step)(s, ev, qids)
+
+        promote_b = jax.vmap(
+            build_promote_stacked(group.tlist, cfg, group.p)
+        )
+        rows_ix = jnp.asarray(group.rows, jnp.int32)
+
+        def scan(eng: EngineState, events: EventBatch, promo_pg):
+            ev = rep(events)
+            # [Np, K, T, ...] -> member rows -> flat [Qg*K, T, ...].
+            pr = jax.tree_util.tree_map(
+                lambda x: x[rows_ix].reshape((L,) + x.shape[2:]),
+                promo_pg,
+            )
+            swap = lambda x: jnp.swapaxes(x, 0, 1)
+            ev_t = jax.tree_util.tree_map(swap, ev)
+            pr_t = jax.tree_util.tree_map(swap, pr)
+
+            def body(s, x):
+                ev1, pr1 = x
+                # Step first, then promote: the prefix completes *at*
+                # event t and the promoted run first evaluates at t+1 —
+                # the untiered run's schedule (parallel/tiered.py).
+                s, out = base_step(s, ev1)
+                s, n = promote_b(
+                    s, pr1.fire, pr1.offs, pr1.anchor_ts, pr1.sver, qids
+                )
+                return s, (out, n)
+
+            eng, (outs, ns) = jax.lax.scan(body, eng, (ev_t, pr_t))
+            outs = unstack(jax.tree_util.tree_map(swap, outs))
+            promoted = jnp.sum(ns, axis=0).reshape(Qg, K)
+            return eng, outs, promoted
+
+        scan_jit = jax.jit(scan)
+
+    drain_jit = jax.jit(jax.vmap(build_drain(cfg)))
+    return (step, init_fn, phases, scan_jit, drain_jit)
+
+
+class TenantBankMatcher:
+    """N queries x ``K`` lanes under one bank plan (one chip).
+
+    Drop-in for :class:`~kafkastreams_cep_tpu.parallel.stacked.
+    StackedBankMatcher` (same ``scan``/``init_state``/``drain``/counters
+    surface, ``[N, K, T, R, W]`` outputs decoded per query with
+    :meth:`names_of`) without its same-shape requirement: queries group
+    by shape internally and the whole bank shares one prefix screen.
+
+    ``names`` optionally labels queries for the per-query telemetry
+    breakdown (defaults to ``q0..qN-1``).
+    """
+
+    def __init__(
+        self,
+        patterns: Sequence,
+        lanes_per_query: int,
+        config: Optional[EngineConfig] = None,
+        profile: Optional[Dict] = None,
+        reorder: bool = True,
+        names: Optional[Sequence[str]] = None,
+    ):
+        self.config = config or EngineConfig()
+        self.K = int(lanes_per_query)
+        self.bank: BankPlan = plan_bank(
+            patterns, self.config, profile, reorder
+        )
+        self.N = len(self.bank.queries)
+        self.query_names = (
+            list(names)
+            if names is not None
+            else [f"q{q}" for q in range(self.N)]
+        )
+        if len(self.query_names) != self.N:
+            raise ValueError("names must have one entry per pattern")
+        self.scan_calls = 0
+        self.nfa_dispatches = 0
+
+        # -- prefix-length groups (the shared screen frontier) --------------
+        by_p: Dict[int, List[int]] = {}
+        for q, qp in enumerate(self.bank.queries):
+            if qp.plan.tier != TIER_NFA:
+                by_p.setdefault(qp.plan.prefix_len, []).append(q)
+        self._pgroups: List[_PrefixGroup] = []
+        for p in sorted(by_p):
+            qids = by_p[p]
+            sigs = np.asarray(
+                [self.bank.queries[q].prefix_cols for q in qids],
+                np.int32,
+            )
+            srows = [
+                i
+                for i, q in enumerate(qids)
+                if self.bank.queries[q].plan.tier != TIER_HYBRID
+            ]
+            self._pgroups.append(
+                _PrefixGroup(
+                    p=p, qids=qids, sigs=sigs, stencil_rows=srows,
+                    stencil_qids=[qids[i] for i in srows],
+                )
+            )
+        member_row = {
+            (i, q): r
+            for i, pg in enumerate(self._pgroups)
+            for r, q in enumerate(pg.qids)
+        }
+
+        # -- residual engine groups -----------------------------------------
+        groups: Dict[tuple, _EngineGroup] = {}
+        for q, qp in enumerate(self.bank.queries):
+            if qp.plan.tier == TIER_HYBRID:
+                pgi = next(
+                    i
+                    for i, pg in enumerate(self._pgroups)
+                    if q in pg.qids
+                )
+                key = (
+                    "hybrid", qp.plan.prefix_len, _stack_sig(qp.tables),
+                )
+                g = groups.setdefault(
+                    key,
+                    _EngineGroup(
+                        kind="hybrid", qids=[], tlist=[],
+                        p=qp.plan.prefix_len, pg=pgi, rows=[],
+                    ),
+                )
+                g.qids.append(q)
+                g.tlist.append(qp.tables)
+                g.rows.append(member_row[(pgi, q)])
+            elif qp.plan.tier == TIER_NFA:
+                key = ("nfa", _stack_sig(qp.tables))
+                g = groups.setdefault(
+                    key,
+                    _EngineGroup(
+                        kind="nfa", qids=[], tlist=[], p=0, pg=None,
+                        rows=[],
+                    ),
+                )
+                g.qids.append(q)
+                g.tlist.append(qp.tables)
+        self._groups: List[_EngineGroup] = list(groups.values())
+        for g in self._groups:
+            g.programs = self._cached_group_programs(g)
+        self._hybrid_idx = [
+            i for i, g in enumerate(self._groups) if g.kind == "hybrid"
+        ]
+
+        logger.info(
+            "tenant bank: %d queries -> %d prefix groups (%d columns, "
+            "shared hit rate %.2f), %d engine groups (%d hybrid), "
+            "predicate dedup %.2fx",
+            self.N, len(self._pgroups),
+            self.bank.stats["prefix_columns_distinct"],
+            self.bank.stats["prefix_shared_hit_rate"],
+            len(self._groups), len(self._hybrid_idx),
+            self.bank.stats["pred_dedup_ratio"],
+        )
+
+        self._screen_jit = self._cached_screen()
+
+    # -- program construction (trace-cached) ---------------------------------
+
+    def _struct_key(self):
+        bkey = bank_key([qp.tables for qp in self.bank.queries])
+        if bkey is None:
+            return None
+        struct = (
+            tuple(
+                (pg.p, pg.sigs.tobytes(), tuple(pg.stencil_rows))
+                for pg in self._pgroups
+            ),
+            tuple(
+                (g.kind, g.p, g.pg, tuple(g.rows), tuple(g.qids))
+                for g in self._groups
+            ),
+        )
+        return (bkey, dataclasses.astuple(self.config), struct)
+
+    def _cached_group_programs(self, g: _EngineGroup):
+        key = bank_key(g.tlist)
+        if key is not None:
+            # K is part of the key: the group's per-lane qid table and
+            # the walk-kernel feasibility decision are baked into the
+            # closure at [Qg*K] lanes.
+            key = (
+                key, dataclasses.astuple(self.config), g.kind, g.p,
+                tuple(g.rows), self.K,
+                _select_walk_kernel(self.config, g.Q * self.K),
+            )
+        return tracecache.lookup(
+            "tenant.group_programs", key,
+            lambda: _build_group_programs(g, self.config, self.K),
+        )
+
+    def _cached_screen(self):
+        if not self._pgroups:
+            return None
+        key = self._struct_key()
+        return tracecache.lookup(
+            "tenant.screen", key, lambda: jax.jit(self._build_screen())
+        )
+
+    def _build_screen(self):
+        """The whole-bank screen: matrix -> per-p-group recurrence ->
+        stencil synthesis + hybrid gates, one fused program."""
+        owner_tables = [qp.tables for qp in self.bank.queries]
+        matrix_fn = build_matrix(self.bank.columns, owner_tables)
+        scans = [bank_prefix_scan(pg.p) for pg in self._pgroups]
+        synths = []
+        for pg in self._pgroups:
+            if pg.stencil_qids:
+                synths.append(
+                    (
+                        jnp.asarray(pg.stencil_rows, jnp.int32),
+                        stencil_step_output_stacked(
+                            [
+                                self.bank.queries[q].tables
+                                for q in pg.stencil_qids
+                            ],
+                            self.config, pg.p,
+                        ),
+                    )
+                )
+            else:
+                synths.append(None)
+        hybrids = [
+            (self._groups[i].pg,
+             jnp.asarray(self._groups[i].rows, jnp.int32))
+            for i in self._hybrid_idx
+        ]
+        sig_tables = [pg.sigs for pg in self._pgroups]
+
+        def screen(carries, alives, ev: EventBatch):
+            mat = matrix_fn(ev)
+            new_carries, promos, souts = [], [], []
+            for i, (scan, synth) in enumerate(zip(scans, synths)):
+                bools_q = group_bools(mat, sig_tables[i])
+                c2, promo = scan(carries[i], bools_q, ev)
+                new_carries.append(c2)
+                promos.append(promo)
+                if synth is None:
+                    souts.append(None)
+                else:
+                    srows, synth_fn = synth
+                    souts.append(
+                        synth_fn(
+                            jax.tree_util.tree_map(
+                                lambda x: x[srows], promo
+                            )
+                        )
+                    )
+            if hybrids:
+                gates = jnp.stack(
+                    [
+                        jnp.any(alives[i])
+                        | jnp.any(promos[pgi].fire[rows])
+                        for i, (pgi, rows) in enumerate(hybrids)
+                    ]
+                )
+            else:
+                gates = jnp.zeros((0,), bool)
+            return (
+                tuple(new_carries), tuple(promos), tuple(souts), gates,
+            )
+
+        return screen
+
+    # -- state ----------------------------------------------------------------
+
+    def names_of(self, q: int) -> List[str]:
+        return self.bank.queries[q].tables.names
+
+    def tier_of(self, q: int) -> str:
+        return self.bank.queries[q].plan.tier
+
+    def init_state(self) -> TenantState:
+        engines = []
+        for g in self._groups:
+            _, init_fn, _, _, _ = g.programs
+            per_q = []
+            for lq in range(g.Q):
+                s = (
+                    init_fn(lq)
+                    if g.kind == "nfa"
+                    # Hybrid: the begin stage lives on the stencil tier,
+                    # so the group queue starts empty (engine/tiered.py).
+                    else seedless_init(lambda lq=lq: init_fn(lq))
+                )
+                per_q.append(s)
+            engines.append(
+                jax.tree_util.tree_map(
+                    lambda *xs: jnp.concatenate(
+                        [
+                            jnp.broadcast_to(x, (self.K,) + x.shape)
+                            for x in xs
+                        ]
+                    ),
+                    *per_q,
+                )
+            )
+        carries = tuple(
+            init_carries(len(pg.qids), self.K, pg.p)
+            for pg in self._pgroups
+        )
+        return TenantState(engine=tuple(engines), carry=carries)
+
+    # -- the scan --------------------------------------------------------------
+
+    def _zero_group_out(self, Qg: int, T: int) -> StepOutput:
+        cfg = self.config
+        K, R, W = self.K, cfg.max_runs, cfg.max_walk
+        i32 = jnp.int32
+        return StepOutput(
+            stage=jnp.full((Qg, K, T, R, W), -1, i32),
+            off=jnp.full((Qg, K, T, R, W), -1, i32),
+            count=jnp.zeros((Qg, K, T, R), i32),
+        )
+
+    def scan(self, state: TenantState, events: EventBatch):
+        """One ``[K, T]`` batch through the whole bank.  Every query sees
+        every record (the reference's one-processor-per-pattern topology);
+        outputs come back ``[N, K, T, R, W]`` in original query order.
+        Host-gated like the single-query tiered matcher, so not itself
+        jittable."""
+        T = int(events.ts.shape[1])
+        self.scan_calls += 1
+        if self._screen_jit is not None:
+            alives = tuple(
+                state.engine[i].alive for i in self._hybrid_idx
+            )
+            carries, promos, souts, gates = self._screen_jit(
+                state.carry, alives, events
+            )
+            carries = list(carries)
+            gates_h = np.asarray(jax.device_get(gates))
+        else:
+            carries, promos, souts, gates_h = [], (), (), np.zeros(0)
+
+        blocks: List[Tuple[List[int], StepOutput]] = []
+        for pg, so in zip(self._pgroups, souts):
+            if so is not None:
+                blocks.append((pg.stencil_qids, so))
+
+        engines = list(state.engine)
+        hseq = 0
+        for i, g in enumerate(self._groups):
+            if g.kind == "nfa":
+                self.nfa_dispatches += 1
+                _, _, _, scan_jit, _ = g.programs
+                engines[i], out_g = scan_jit(engines[i], events)
+                blocks.append((g.qids, out_g))
+                continue
+            gate = bool(gates_h[hseq])
+            hseq += 1
+            if not gate:
+                # Exact skip: stepping an empty, promotion-free group
+                # changes nothing but step_seq (parallel/tiered.py).
+                engines[i] = _bump_engine_jit()(
+                    engines[i], jnp.int32(T)
+                )
+                blocks.append((g.qids, self._zero_group_out(g.Q, T)))
+                continue
+            self.nfa_dispatches += 1
+            _, _, _, scan_jit, _ = g.programs
+            engines[i], out_g, promoted = scan_jit(
+                engines[i], events, promos[g.pg]
+            )
+            c = carries[g.pg]
+            carries[g.pg] = c._replace(
+                promotions=c.promotions.at[
+                    jnp.asarray(g.rows, jnp.int32)
+                ].add(promoted)
+            )
+            blocks.append((g.qids, out_g))
+
+        out = self._assemble(blocks)
+        return (
+            TenantState(engine=tuple(engines), carry=tuple(carries)),
+            out,
+        )
+
+    def _assemble(self, blocks):
+        """Concatenate per-group ``[n, ...]`` output blocks and permute
+        back to original query order along the leading axis."""
+        order = np.concatenate(
+            [np.asarray(qids, np.int64) for qids, _ in blocks]
+        )
+        inv = jnp.asarray(np.argsort(order), jnp.int32)
+        parts = [out for _, out in blocks]
+        if len(parts) == 1:
+            return jax.tree_util.tree_map(lambda x: x[inv], parts[0])
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0)[inv], *parts
+        )
+
+    # -- maintenance / drains --------------------------------------------------
+
+    def sweep(self, state: TenantState) -> TenantState:
+        """Engine-group maintenance sweeps; stencil carries hold no slab
+        references (partial prefixes own no entries) so they ride along
+        untouched."""
+        depth = self.config.max_walk
+        do_renorm = self.config.renorm_versions
+        swp = tracecache.lookup(
+            "batch.sweep", (depth, do_renorm),
+            lambda: jax.jit(
+                lambda s: sweep_lanes(s, depth, do_renorm)
+            ),
+        )
+        return state._replace(
+            engine=tuple(swp(e) for e in state.engine)
+        )
+
+    def _zero_drain(self, n: int) -> DrainOutput:
+        cfg = self.config
+        HB, W = cfg.handle_ring, cfg.max_walk
+        i32 = jnp.int32
+        full = lambda shape: jnp.full(shape, -1, i32)
+        return DrainOutput(
+            stage=full((n, self.K, HB, W)),
+            off=full((n, self.K, HB, W)),
+            count=jnp.zeros((n, self.K, HB), i32),
+            seq=full((n, self.K, HB)),
+            row=full((n, self.K, HB)),
+            ts=full((n, self.K, HB)),
+        )
+
+    def drain(self, state: TenantState):
+        """Materialize pending lazy-extraction handles for every group;
+        returns ``[N, K, ...]`` outputs in query order (pure-stencil
+        queries never own handles — their rows are the empty drain)."""
+        engines = list(state.engine)
+        blocks: List[Tuple[List[int], DrainOutput]] = []
+        covered: set = set()
+        for i, g in enumerate(self._groups):
+            _, _, _, _, drain_jit = g.programs
+            engines[i], d = drain_jit(engines[i])
+            blocks.append(
+                (
+                    g.qids,
+                    jax.tree_util.tree_map(
+                        lambda x: x.reshape(
+                            (g.Q, self.K) + x.shape[1:]
+                        ),
+                        d,
+                    ),
+                )
+            )
+            covered.update(g.qids)
+        rest = [q for q in range(self.N) if q not in covered]
+        if rest:
+            blocks.append((rest, self._zero_drain(len(rest))))
+        out = self._assemble(blocks)
+        return state._replace(engine=tuple(engines)), out
+
+    # -- telemetry -------------------------------------------------------------
+
+    def _summed(self, state: TenantState, names, values_fn):
+        tot = dict.fromkeys(names, 0)
+        for eng in state.engine:
+            for n, v in zip(names, values_fn(eng)):
+                tot[n] += int(jnp.sum(v))
+        return tot
+
+    def counters(self, state: TenantState) -> Dict[str, int]:
+        return self._summed(state, COUNTER_NAMES, counter_values)
+
+    def hot_counters(self, state: TenantState) -> Dict[str, int]:
+        return self._summed(
+            state, HOT_COUNTER_NAMES, hot_counter_values
+        )
+
+    def walk_counters(self, state: TenantState) -> Dict[str, int]:
+        return self._summed(
+            state, WALK_COUNTER_NAMES, walk_counter_values
+        )
+
+    def tier_counters(self, state: TenantState) -> Dict[str, int]:
+        vals = [0, 0, 0]
+        for c in state.carry:
+            got = jax.device_get(
+                (
+                    jnp.sum(c.screened), jnp.sum(c.fires),
+                    jnp.sum(c.promotions),
+                )
+            )
+            vals = [a + int(b) for a, b in zip(vals, got)]
+        return dict(zip(TIER_COUNTER_NAMES, vals))
+
+    def per_query_counters(
+        self, state: TenantState
+    ) -> Dict[str, Dict[str, int]]:
+        """Per-query attribution across the whole bank: loss + hot +
+        walk counters summed over each query's ``K``-lane block of its
+        group, plus that query's stencil-tier telemetry.  Queries with
+        no residual engine (pure stencil) report zero engine counters."""
+        names = COUNTER_NAMES + HOT_COUNTER_NAMES + WALK_COUNTER_NAMES
+        per_q: Dict[int, Dict[str, int]] = {
+            q: dict.fromkeys(names, 0) for q in range(self.N)
+        }
+        for g, eng in zip(self._groups, state.engine):
+            arrays = per_lane_counter_arrays(eng)
+            for r, q in enumerate(g.qids):
+                for n, v in arrays.items():
+                    per_q[q][n] = int(
+                        v.reshape(g.Q, self.K)[r].sum()
+                    )
+        tier_zero = dict.fromkeys(TIER_COUNTER_NAMES, 0)
+        for q in range(self.N):
+            per_q[q].update(tier_zero)
+        for pg, c in zip(self._pgroups, state.carry):
+            scr, fr, pr = jax.device_get(
+                (
+                    jnp.sum(c.screened, axis=1),
+                    jnp.sum(c.fires, axis=1),
+                    jnp.sum(c.promotions, axis=1),
+                )
+            )
+            for r, q in enumerate(pg.qids):
+                per_q[q][TIER_COUNTER_NAMES[0]] = int(scr[r])
+                per_q[q][TIER_COUNTER_NAMES[1]] = int(fr[r])
+                per_q[q][TIER_COUNTER_NAMES[2]] = int(pr[r])
+        return {
+            self.query_names[q]: per_q[q] for q in range(self.N)
+        }
+
+    def metrics_snapshot(self, state: TenantState) -> Dict[str, object]:
+        """Bank-wide telemetry: merged engine counters, shared-screen
+        tier counters, compile-time sharing stats, and the ``per_query``
+        breakdown (rendered as ``cep_*{query="..."}`` by
+        ``utils/telemetry.py``)."""
+        out: Dict[str, object] = {}
+        out.update(self.counters(state))
+        out.update(self.hot_counters(state))
+        out.update(self.walk_counters(state))
+        out.update(self.tier_counters(state))
+        out["bank_queries"] = self.N
+        out["bank_prefix_groups"] = len(self._pgroups)
+        out["bank_engine_groups"] = len(self._groups)
+        out["bank_pred_dedup_ratio"] = float(
+            self.bank.stats["pred_dedup_ratio"]
+        )
+        out["bank_prefix_shared_hit_rate"] = float(
+            self.bank.stats["prefix_shared_hit_rate"]
+        )
+        out["per_query"] = self.per_query_counters(state)
+        return out

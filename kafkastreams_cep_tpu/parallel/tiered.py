@@ -80,6 +80,18 @@ from kafkastreams_cep_tpu.utils.logging import get_logger
 logger = get_logger("parallel.tiered")
 
 
+@functools.lru_cache(maxsize=1)
+def _bump_engine_jit():
+    """Process-wide singleton (pattern-free: pure pytree surgery)."""
+    return jax.jit(lambda eng, t: eng._replace(step_seq=eng.step_seq + t))
+
+
+@functools.lru_cache(maxsize=1)
+def _gate_engine_jit():
+    """Process-wide singleton (pattern-free reduction)."""
+    return jax.jit(lambda alive, fire: jnp.any(alive) | jnp.any(fire))
+
+
 class TieredBatchMatcher:
     """``K`` lanes matched under a compiler tiering plan (one chip).
 
@@ -130,8 +142,11 @@ class TieredBatchMatcher:
             self._prefix = StencilPrefix(tables, num_lanes, p)
             self._promote = build_promote(tables, config, p)
             if self.plan.tier == TIER_STENCIL:
-                self._synth = jax.jit(
-                    stencil_step_output(tables, config, p)
+                self._synth = self._cached(
+                    "tiered.synth", (p,),
+                    lambda: jax.jit(
+                        stencil_step_output(tables, config, p)
+                    ),
                 )
             if self.inner.uses_scan_kernel:
                 # The whole-scan Pallas program has no per-step promotion
@@ -174,17 +189,31 @@ class TieredBatchMatcher:
 
     # -- the scan ------------------------------------------------------------
 
-    @functools.cached_property
+    def _cached(self, namespace, tag, build):
+        """Trace-cache lookup keyed by this matcher's (tables, config)
+        fingerprint plus ``tag`` (utils/tracecache.py)."""
+        import dataclasses as _dc
+
+        from kafkastreams_cep_tpu.compiler.multitenant import tables_key
+        from kafkastreams_cep_tpu.utils import tracecache
+
+        tkey = tables_key(self.tables)
+        key = (
+            None
+            if tkey is None
+            else (tkey, _dc.astuple(self.matcher.config)) + tuple(tag)
+        )
+        return tracecache.lookup(namespace, key, build)
+
+    @property
     def _bump_jit(self):
         """Advance ``step_seq`` by T without stepping: the exact effect a
         full scan of an empty, promotion-free queue would have had."""
-        return jax.jit(
-            lambda eng, t: eng._replace(step_seq=eng.step_seq + t)
-        )
+        return _bump_engine_jit()
 
-    @functools.cached_property
+    @property
     def _gate_jit(self):
-        return jax.jit(lambda alive, fire: jnp.any(alive) | jnp.any(fire))
+        return _gate_engine_jit()
 
     @functools.cached_property
     def _hybrid_scan_jit(self):
@@ -214,7 +243,14 @@ class TieredBatchMatcher:
             outs = jax.tree_util.tree_map(swap, outs)
             return eng, outs, jnp.sum(ns, axis=0)  # ns: [T, K] -> [K]
 
-        return jax.jit(scan)
+        return self._cached(
+            "tiered.hybrid_scan",
+            (
+                self.plan.prefix_len, self.inner.uses_walk_kernel,
+                self.inner._kernel_interpret,
+            ),
+            lambda: jax.jit(scan),
+        )
 
     def _zero_out(self, T: int) -> StepOutput:
         cfg = self.matcher.config

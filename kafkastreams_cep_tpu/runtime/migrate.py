@@ -152,6 +152,18 @@ def widen_state(
     if inner is not None:
         import jax as _jax
 
+        if isinstance(inner, (tuple, list)) and not hasattr(
+            inner, "_fields"
+        ):
+            # Multi-tenant bank state (parallel/tenantbank.py): a PLAIN
+            # tuple of engines, one stacked engine per residual group
+            # (an EngineState itself is a NamedTuple and must NOT take
+            # this branch), one carry per prefix group — widen each
+            # engine, carries copy verbatim.
+            return state._replace(
+                engine=tuple(widen_state(e, old, new) for e in inner),
+                carry=_jax.tree_util.tree_map(np.asarray, state.carry),
+            )
         return state._replace(
             engine=widen_state(inner, old, new),
             carry=_jax.tree_util.tree_map(np.asarray, state.carry),
@@ -249,6 +261,15 @@ def canonical_state(state: EngineState) -> EngineState:
     if inner is not None:
         import jax as _jax
 
+        if isinstance(inner, (tuple, list)) and not hasattr(
+            inner, "_fields"
+        ):
+            # Multi-tenant bank: plain tuple of per-group engines (an
+            # EngineState NamedTuple must NOT take this branch).
+            return state._replace(
+                engine=tuple(canonical_state(e) for e in inner),
+                carry=_jax.tree_util.tree_map(np.asarray, state.carry),
+            )
         return state._replace(
             engine=canonical_state(inner),
             carry=_jax.tree_util.tree_map(np.asarray, state.carry),

@@ -1,0 +1,493 @@
+"""Multi-tenant runtime: N queries, one record stream, one device program.
+
+The serial bank (``runtime/bank.py: CEPBank``) is the reference topology —
+one ``CEPProcessor`` per pattern, N dispatches per batch.  This module is
+the shared-execution analog over
+:class:`~kafkastreams_cep_tpu.parallel.tenantbank.TenantBankMatcher`: one
+key→lane routing table, one packed ``[K, T]`` batch, one screened bank
+dispatch, and per-query decode with that query's stage names.  Emission
+contract per query matches ``CEPProcessor``: by arrival of the completing
+record, then run-queue order; queries report in declaration order (the
+``CEPBank.process`` contract).
+
+Durability follows ``runtime/checkpoint.py`` exactly: checkpoints carry
+arrays + names, never code (the ``ComputationStageSerDe`` contract);
+restore recompiles the bank from user patterns and refuses a topology
+whose per-query stage names differ.  :class:`TenantSupervisor` adds the
+checkpoint-every-N / restore-replay-retry loop of
+``runtime/supervisor.py`` scoped to the tenant runtime — replayed
+batches' matches are suppressed (already emitted by the pre-fault
+incarnation), so a recovered stream is exactly-once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Hashable, List, Optional, Sequence as Seq, Tuple
+
+import jax
+import numpy as np
+
+from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.parallel.tenantbank import (
+    TenantBankMatcher,
+    TenantState,
+)
+from kafkastreams_cep_tpu.runtime.checkpoint import (
+    CheckpointCorrupt,
+    _flatten_state,
+    _unflatten_state,
+)
+from kafkastreams_cep_tpu.runtime.processor import (
+    InputRejected,
+    Record,
+    _bucket,
+)
+from kafkastreams_cep_tpu.utils.events import Event, Sequence
+from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
+from kafkastreams_cep_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.tenant")
+
+TENANT_FORMAT_VERSION = 1
+
+_I32 = np.iinfo(np.int32)
+
+
+class TenantCEP:
+    """N named queries over one stream, one bank dispatch per batch.
+
+    ``patterns`` maps query name -> built pattern (declaration order is
+    emission order, like :class:`~kafkastreams_cep_tpu.runtime.bank.
+    CEPBank`).  Keys claim lanes first-seen like ``CEPProcessor`` (one
+    more key than lanes raises); every query sees every record.  Values
+    must share one numeric pytree structure, fixed by the first record.
+    """
+
+    def __init__(
+        self,
+        patterns: Dict[str, object],
+        num_lanes: int,
+        config: Optional[EngineConfig] = None,
+        topic: str = "stream",
+        profile: Optional[Dict] = None,
+        reorder: bool = True,
+    ):
+        if not patterns:
+            raise ValueError("a tenant bank needs at least one pattern")
+        self.query_names = list(patterns)
+        self.batch = TenantBankMatcher(
+            list(patterns.values()), num_lanes, config,
+            profile=profile, reorder=reorder, names=self.query_names,
+        )
+        self.num_lanes = int(num_lanes)
+        self.topic = topic
+        self.state: TenantState = self.batch.init_state()
+        self._lane_of: Dict[Hashable, int] = {}
+        self._key_of: Dict[int, Hashable] = {}
+        self._next_offset = np.zeros(self.num_lanes, np.int64)
+        self._events: List[Dict[int, Event]] = [
+            {} for _ in range(self.num_lanes)
+        ]
+        self._value_proto: Any = None
+        self.batches = 0
+
+    # -- routing --------------------------------------------------------------
+
+    def lane(self, key: Hashable) -> int:
+        existing = self._lane_of.get(key)
+        if existing is not None:
+            return existing
+        lane = len(self._lane_of)
+        if lane >= self.num_lanes:
+            raise InputRejected(
+                f"key {key!r}: more than num_lanes={self.num_lanes} "
+                "distinct keys; size the tenant runtime for the key "
+                "cardinality it serves"
+            )
+        self._lane_of[key] = lane
+        self._key_of[lane] = key
+        return lane
+
+    def _key_code(self, key: Hashable, lane: int) -> int:
+        if isinstance(key, (int, np.integer)) and _I32.min <= key <= _I32.max:
+            return int(key)
+        return lane
+
+    # -- the per-batch path ---------------------------------------------------
+
+    def process(
+        self, records: Seq[Record]
+    ) -> List[Tuple[str, Hashable, Sequence]]:
+        """One micro-batch through the whole bank.  Returns
+        ``(query_name, key, Sequence)`` triples — queries in declaration
+        order, each query's matches in arrival-then-queue order."""
+        records = list(records)
+        if not records:
+            return []
+        events, rank_of = self._pack(records)
+        _failpoint("device.dispatch")
+        self.state, out = self.batch.scan(self.state, events)
+        _failpoint("device.result")
+        self.batches += 1
+        matches: List[Tuple[str, Hashable, Sequence]] = []
+        count = np.asarray(jax.device_get(out.count))  # [N, K, T, R]
+        stage = np.asarray(jax.device_get(out.stage))
+        off = np.asarray(jax.device_get(out.off))
+        for q, qname in enumerate(self.query_names):
+            names = self.batch.names_of(q)
+            ks, ts, rs = np.nonzero(count[q])
+            if ks.size == 0:
+                continue
+            order = np.lexsort((rs, rank_of[ks, ts]))
+            ks, ts, rs = ks[order], ts[order], rs[order]
+            for i in range(ks.size):
+                k = int(ks[i])
+                seq = Sequence()
+                for w in range(int(count[q, k, ts[i], rs[i]])):
+                    seq.add(
+                        names[int(stage[q, k, ts[i], rs[i], w])],
+                        self._events[k][int(off[q, k, ts[i], rs[i], w])],
+                    )
+                matches.append((qname, self._key_of[k], seq))
+        return matches
+
+    def _pack(self, records: List[Record]):
+        """Per-lane queues -> right-padded ``[K, T]`` device batch, plus
+        the ``[K, T]`` arrival-rank table the emitter sorts by."""
+        per_lane: List[List[Tuple[int, Record]]] = [
+            [] for _ in range(self.num_lanes)
+        ]
+        for rank, rec in enumerate(records):
+            if not (_I32.min <= int(rec.timestamp) <= _I32.max):
+                raise InputRejected(
+                    f"record {rank} (key {rec.key!r}): timestamp "
+                    f"{rec.timestamp} outside int32 device time"
+                )
+            per_lane[self.lane(rec.key)].append((rank, rec))
+        if self._value_proto is None:
+            self._value_proto = records[0].value
+        dtypes, treedef = jax.tree_util.tree_flatten(self._value_proto)
+        K = self.num_lanes
+        T = _bucket(max(len(q) for q in per_lane))
+        key_arr = np.zeros((K, T), np.int32)
+        ts_arr = np.zeros((K, T), np.int32)
+        off_arr = np.full((K, T), -1, np.int32)
+        valid = np.zeros((K, T), bool)
+        rank_of = np.full((K, T), np.iinfo(np.int64).max, np.int64)
+        leaves = [
+            np.zeros(
+                (K, T),
+                np.float32 if isinstance(p, float) else np.int32,
+            )
+            for p in dtypes
+        ]
+        for k, queue in enumerate(per_lane):
+            for t, (rank, rec) in enumerate(queue):
+                rec_leaves, rec_def = jax.tree_util.tree_flatten(rec.value)
+                if rec_def != treedef:
+                    raise InputRejected(
+                        f"record {rank} (key {rec.key!r}): value structure "
+                        f"{rec_def} does not match the stream schema "
+                        f"{treedef}"
+                    )
+                o = int(self._next_offset[k])
+                self._next_offset[k] = o + 1
+                key_arr[k, t] = self._key_code(rec.key, k)
+                ts_arr[k, t] = int(rec.timestamp)
+                off_arr[k, t] = o
+                valid[k, t] = True
+                rank_of[k, t] = rank
+                for leaf, v in zip(leaves, rec_leaves):
+                    leaf[k, t] = v
+                self._events[k][o] = Event(
+                    rec.key, rec.value, int(rec.timestamp), self.topic,
+                    k, o,
+                )
+        value = jax.tree_util.tree_unflatten(treedef, leaves)
+        return (
+            EventBatch(
+                key=key_arr, value=value, ts=ts_arr, off=off_arr,
+                valid=valid,
+            ),
+            rank_of,
+        )
+
+    # -- telemetry ------------------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        return self.batch.counters(self.state)
+
+    def tier_counters(self) -> Dict[str, int]:
+        return self.batch.tier_counters(self.state)
+
+    def per_query_counters(self) -> Dict[str, Dict[str, int]]:
+        return self.batch.per_query_counters(self.state)
+
+    def metrics_snapshot(self) -> Dict[str, object]:
+        return self.batch.metrics_snapshot(self.state)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / restore (the changelog-store analog for the whole bank)
+# ---------------------------------------------------------------------------
+
+
+def save_tenant_checkpoint(
+    tenant: TenantCEP, path: str, extra: Optional[Dict[str, Any]] = None
+) -> None:
+    """Snapshot a tenant runtime to one file — arrays + names, no code.
+
+    The array payload is the flattened :class:`TenantState` pytree (per
+    residual group engines, per prefix-length group carries); the header
+    records every query's stage names so restore can hold the whole bank
+    to the lookup-by-name contract at once."""
+    _failpoint("checkpoint.save")
+    arrays = _flatten_state(tenant.state)
+    header = {
+        "format_version": TENANT_FORMAT_VERSION,
+        "extra": dict(extra or {}),
+        "query_names": list(tenant.query_names),
+        "stage_names": {
+            name: list(tenant.batch.names_of(q))
+            for q, name in enumerate(tenant.query_names)
+        },
+        "config": dataclasses.asdict(tenant.batch.config),
+        "num_lanes": tenant.num_lanes,
+        "topic": tenant.topic,
+        "lane_of": dict(tenant._lane_of),
+        "next_offset": tenant._next_offset.copy(),
+        "events": [dict(d) for d in tenant._events],
+        "value_proto": tenant._value_proto,
+        "batches": tenant.batches,
+    }
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    header["arrays_sha256"] = hashlib.sha256(buf.getvalue()).hexdigest()
+    with open(path, "wb") as f:
+        pickle.dump({"header": header, "arrays": buf.getvalue()}, f)
+    logger.info(
+        "tenant checkpoint saved to %s: %d queries, %d lanes",
+        path, len(tenant.query_names), tenant.num_lanes,
+    )
+
+
+def load_tenant_checkpoint(path: str) -> Dict[str, Any]:
+    try:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        header = blob["header"]
+    except (OSError, FileNotFoundError):
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: {e})"
+        ) from e
+    if header["format_version"] != TENANT_FORMAT_VERSION:
+        raise ValueError(
+            f"tenant checkpoint format {header['format_version']} "
+            "unsupported"
+        )
+    got = hashlib.sha256(blob["arrays"]).hexdigest()
+    if got != header["arrays_sha256"]:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} failed integrity check: array payload "
+            f"sha256 {got} != header digest {header['arrays_sha256']}"
+        )
+    try:
+        with np.load(io.BytesIO(blob["arrays"])) as z:
+            arrays = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} array payload is unreadable "
+            f"({type(e).__name__}: {e})"
+        ) from e
+    return {"header": header, "arrays": arrays}
+
+
+def restore_tenant(
+    patterns: Dict[str, object],
+    path: str,
+    ckpt: Optional[Dict[str, Any]] = None,
+) -> TenantCEP:
+    """Rebuild a tenant runtime from user code + a checkpoint.
+
+    Patterns are compiled fresh (predicates and folds come from code);
+    the checkpoint supplies state only.  A bank whose query names or any
+    query's stage names differ from the snapshot is refused."""
+    if ckpt is None:
+        ckpt = load_tenant_checkpoint(path)
+    header = ckpt["header"]
+    if list(patterns) != list(header["query_names"]):
+        raise ValueError(
+            f"query names do not match checkpoint: {list(patterns)} vs "
+            f"{header['query_names']}"
+        )
+    config = EngineConfig(**header["config"])
+    tenant = TenantCEP(
+        patterns, header["num_lanes"], config, topic=header["topic"]
+    )
+    for q, name in enumerate(tenant.query_names):
+        want = list(header["stage_names"][name])
+        got = list(tenant.batch.names_of(q))
+        if got != want:
+            raise ValueError(
+                f"query {name!r} topology does not match checkpoint: "
+                f"stages {got} vs checkpoint {want}"
+            )
+    tenant.state = _unflatten_state(tenant.state, ckpt["arrays"])
+    tenant._lane_of = dict(header["lane_of"])
+    tenant._key_of = {v: k for k, v in tenant._lane_of.items()}
+    tenant._next_offset = np.asarray(header["next_offset"]).copy()
+    tenant._events = [dict(d) for d in header["events"]]
+    tenant._value_proto = header["value_proto"]
+    tenant.batches = int(header["batches"])
+    logger.info(
+        "restored tenant runtime from %s: %d queries, %d keys assigned",
+        path, len(tenant.query_names), len(tenant._lane_of),
+    )
+    return tenant
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: checkpoint-every-N + restore / replay / retry
+# ---------------------------------------------------------------------------
+
+
+class TenantSupervisor:
+    """Auto-recovering wrapper for a tenant runtime.
+
+    Every ``checkpoint_every`` batches the full bank state is snapshot
+    (atomic rename — a crash mid-write keeps the previous file).  If a
+    batch raises a device fault, the supervisor restores the latest
+    snapshot (or a fresh bank before the first one), replays the batches
+    journaled since it with their matches *suppressed* (the pre-fault
+    incarnation already emitted them — the exactly-once contract), and
+    retries the failing batch up to ``max_retries`` times.  Deterministic
+    input rejection (:class:`InputRejected`) short-circuits: the batch is
+    bad, not the device, and state was untouched."""
+
+    def __init__(
+        self,
+        patterns: Dict[str, object],
+        num_lanes: int,
+        config: Optional[EngineConfig] = None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 16,
+        max_retries: int = 1,
+        **tenant_kwargs,
+    ):
+        self._patterns = dict(patterns)
+        self._tenant_kwargs = dict(tenant_kwargs)
+        self.tenant = TenantCEP(
+            patterns, num_lanes, config, **tenant_kwargs
+        )
+        self.checkpoint_path = checkpoint_path or os.path.join(
+            tempfile.gettempdir(),
+            f"cep_tenant_{os.getpid()}_{id(self):x}.ckpt",
+        )
+        self.checkpoint_every = int(checkpoint_every)
+        self.max_retries = int(max_retries)
+        self._journal: List[List[Record]] = []
+        self._has_checkpoint = False
+        self.recoveries = 0
+        self.checkpoints = 0
+        self.checkpoint_failures = 0
+
+    def process(
+        self, records: Seq[Record]
+    ) -> List[Tuple[str, Hashable, Sequence]]:
+        records = list(records)
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                matches = self.tenant.process(records)
+                break
+            except InputRejected:
+                raise
+            except Exception as e:  # device fault: recover and retry
+                last_err = e
+                logger.warning(
+                    "batch failed (%s: %s); recovering (attempt %d/%d)",
+                    type(e).__name__, e, attempt + 1, self.max_retries,
+                )
+                self._recover()
+        else:
+            raise last_err  # retries exhausted
+        self._journal.append(records)
+        if len(self._journal) >= self.checkpoint_every:
+            self.checkpoint()
+        return matches
+
+    def checkpoint(self) -> None:
+        """Snapshot now (atomic rename) and truncate the journal."""
+        tmp = self.checkpoint_path + ".tmp"
+        try:
+            save_tenant_checkpoint(
+                self.tenant, tmp, extra={"batches": self.tenant.batches}
+            )
+            os.replace(tmp, self.checkpoint_path)
+        except Exception as e:
+            self.checkpoint_failures += 1
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            logger.warning(
+                "checkpoint save failed (%s: %s); journal retained so "
+                "recovery replays from the previous snapshot",
+                type(e).__name__, e,
+            )
+            return
+        self._has_checkpoint = True
+        self.checkpoints += 1
+        self._journal = []
+
+    def _recover(self) -> None:
+        """Restore the latest good snapshot (or a fresh bank) and replay
+        the journaled batches since it, suppressing their matches.
+
+        Replay runs through the same device failure sites as live
+        traffic, so recovery itself can fault mid-replay; the recovered
+        tenant is only committed once restore + full replay succeed."""
+        self.recoveries += 1
+        last_err: Optional[BaseException] = None
+        for _ in range(32):
+            try:
+                if self._has_checkpoint:
+                    tenant = restore_tenant(
+                        self._patterns, self.checkpoint_path
+                    )
+                else:
+                    tenant = TenantCEP(
+                        self._patterns, self.tenant.num_lanes,
+                        self.tenant.batch.config, **self._tenant_kwargs,
+                    )
+                for batch in self._journal:
+                    # Replay is deterministic; matches were already
+                    # emitted by the pre-fault incarnation, so they are
+                    # suppressed here (the exactly-once contract).
+                    tenant.process(batch)
+            except InputRejected:
+                raise
+            except Exception as e:
+                last_err = e
+                continue
+            self.tenant = tenant
+            return
+        raise RuntimeError(
+            f"tenant recovery failed repeatedly; last error: {last_err}"
+        )
+
+    def counters(self) -> Dict[str, int]:
+        return self.tenant.counters()
+
+    def metrics_snapshot(self) -> Dict[str, object]:
+        out = self.tenant.metrics_snapshot()
+        out["recoveries"] = self.recoveries
+        out["checkpoints"] = self.checkpoints
+        out["checkpoint_failures"] = self.checkpoint_failures
+        return out

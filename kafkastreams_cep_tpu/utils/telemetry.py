@@ -561,6 +561,7 @@ def render_prometheus(
 
     Structural keys get labels instead of name-mangling:
     ``per_lane``  -> ``{lane="i"}``, ``per_pattern`` -> ``{pattern="name"}``,
+    ``per_query`` -> ``{query="name"}`` (the multi-tenant bank),
     ``phases``    -> ``<prefix>_phase_seconds{phase="name"}`` histograms,
     ``dead_letters`` -> ``<prefix>_dead_letters_total{reason="late"}``,
     ``hbm``       -> ``<prefix>_hbm_<stat>`` gauges.  Histogram snapshots
@@ -649,6 +650,22 @@ def render_prometheus(
                             f"{prefix}_{_sanitize(cname)}",
                             v,
                             f'{{pattern="{pat}"}}',
+                        )
+        elif key == "per_query" and isinstance(val, dict):
+            # Multi-tenant bank attribution (parallel/tenantbank.py):
+            # per-query engine + tier counters under a ``query`` label,
+            # so one scrape distinguishes tenants sharing a dispatch.
+            for qname in sorted(val):
+                sub = val[qname]
+                if not isinstance(sub, dict):
+                    continue
+                for cname in sorted(sub):
+                    v = sub[cname]
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        scalar(
+                            f"{prefix}_{_sanitize(cname)}",
+                            v,
+                            f'{{query="{qname}"}}',
                         )
         elif key == "hbm" and isinstance(val, dict):
             for stat in sorted(val):

@@ -1,0 +1,100 @@
+"""Process-level trace cache: share jitted step programs across matchers.
+
+Instantiating a matcher re-traces the engine step (~2-5s of Python/jax
+tracing per ``BatchMatcher``) even when an identical program was just
+built — the persistent XLA compilation cache absorbs only the backend
+compile, not the trace.  Tests, evacuation/rebalance restores, and
+supervisor recoveries all rebuild matchers for patterns the process has
+already compiled, so the suite's wall clock (ROADMAP PR 8 budget note)
+and production recovery latency were paying pure re-trace.
+
+This module is the cache: builders register their result under a
+*structural* key — the pattern tables' fingerprint
+(``compiler/multitenant.py: tables_key``), the engine config, and
+whatever mode flags select the program variant (kernel on/off,
+interpret, lane-count feasibility).  Equal keys guarantee equal traced
+programs, so the cached jitted callables (whose jit cache carries the
+trace *and* the compiled executable) are shared verbatim.  Unkeyable
+patterns (``tables_key`` returns None) bypass the cache and behave
+exactly as before.
+
+``CEP_TRACE_CACHE`` controls it: unset/``1`` = on (default capacity
+256 entries, LRU), ``0``/``off`` = disabled, any integer = capacity.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Hashable, Optional
+
+_DEFAULT_CAPACITY = 256
+
+_lock = threading.Lock()
+_store: "OrderedDict[Hashable, Any]" = OrderedDict()
+_hits = 0
+_misses = 0
+
+
+def capacity() -> int:
+    """Configured entry capacity; 0 disables the cache entirely."""
+    raw = os.environ.get("CEP_TRACE_CACHE", "").strip().lower()
+    if raw in ("", "1", "on", "true"):
+        return _DEFAULT_CAPACITY
+    if raw in ("0", "off", "false"):
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+def lookup(
+    namespace: str, key: Optional[Hashable], build: Callable[[], Any]
+) -> Any:
+    """``build()``'s result cached under ``(namespace, key)``.
+
+    ``key=None`` (an unkeyable pattern) or a disabled cache calls
+    ``build()`` uncached.  LRU eviction keeps at most :func:`capacity`
+    entries alive; evicted entries simply fall back to garbage
+    collection like any un-cached matcher's programs.
+    """
+    global _hits, _misses
+    cap = capacity()
+    if key is None or cap == 0:
+        return build()
+    full = (namespace, key)
+    with _lock:
+        if full in _store:
+            _store.move_to_end(full)
+            _hits += 1
+            return _store[full]
+    value = build()  # outside the lock: builds may be seconds long
+    with _lock:
+        if full not in _store:
+            _misses += 1
+            _store[full] = value
+            while len(_store) > cap:
+                _store.popitem(last=False)
+        _store.move_to_end(full)
+        return _store[full]
+
+
+def stats() -> dict:
+    with _lock:
+        return {
+            "entries": len(_store),
+            "hits": _hits,
+            "misses": _misses,
+            "capacity": capacity(),
+        }
+
+
+def clear() -> None:
+    """Drop every cached program (tests; never needed in production)."""
+    global _hits, _misses
+    with _lock:
+        _store.clear()
+        _hits = 0
+        _misses = 0

@@ -147,3 +147,37 @@ def test_gate_guards_tier_parity_flags():
             bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r05.json"))
         ) or {}
     )
+
+
+def test_gate_guards_tenant_bank_flags():
+    """From BENCH_r07 on, the nested ``tenants`` block's bit-exactness
+    and all-counters-zero flags flatten into guarded ``tenant_*`` flags:
+    the shared-screen bank may never silently diverge from the
+    naive-fused oracle (ISSUE 14 satellite)."""
+    r07 = bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r07.json"))
+    m = bench_gate.extract_metrics(r07)
+    assert m["tenant_match_parity"] is True
+    assert m["tenant_loss_flags"] is True
+    bad = json.loads(json.dumps(r07))
+    bad["parsed"]["tenants"]["match_parity"] = False
+    ok, report = bench_gate.gate(bad, [r07])
+    assert not ok
+    assert any(
+        c["metric"] == "tenant_match_parity" and not c["ok"]
+        for c in report["checks"]
+    )
+    lossy = json.loads(json.dumps(r07))
+    lossy["parsed"]["tenants"]["counters_zero"] = False
+    ok, report = bench_gate.gate(lossy, [r07])
+    assert not ok
+    assert any(
+        c["metric"] == "tenant_loss_flags" and not c["ok"]
+        for c in report["checks"]
+    )
+    # Rounds predating the tenants block stay unguarded on these flags,
+    # so the historical trajectory replays clean (covered above).
+    assert "tenant_match_parity" not in (
+        bench_gate.extract_metrics(
+            bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r06.json"))
+        ) or {}
+    )

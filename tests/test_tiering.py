@@ -19,6 +19,7 @@ The contract (compiler/tiering.py, engine/tiered.py, parallel/tiered.py):
 
 import dataclasses
 import os
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -177,7 +178,10 @@ def test_tiered_bit_identical_jnp(name, factory, tier, p):
     # skip-till-any branches exponentially in consumed events; a shorter
     # trace keeps the shared config drop-free for it too.
     total = 24 if name == "p2_skip_any" else 36
-    codes, rng = random_codes(K, total, seed=hash(name) % 2**32)
+    # crc32, not hash(): str hash is randomized per process, and an
+    # unlucky PYTHONHASHSEED draws a corpus that sheds capacity (the
+    # drop-free assertion below then flakes run-to-run).
+    codes, rng = random_codes(K, total, seed=zlib.crc32(name.encode()))
     pat = factory()
     b = BatchMatcher(pat, K, CFG)
     tm = TieredBatchMatcher(pat, K, CFG)
