@@ -44,6 +44,9 @@ size them), ``CEP_BENCH_TENANTS`` (multi-tenant bank sweep: N
 Zipf-overlapping strict-sequence queries on the shared stencil screen vs
 the naive-fused stacked bank, default 1;
 ``CEP_BENCH_TENANTS_{N,K,T,REPS,POOL,FUSED_MAX}`` size it),
+``CEP_BENCH_ADAPT`` (adaptive recompilation: hybrid sweep under the
+chunk-gated scan + drift A/B with/without ``AdaptPolicy`` replanning,
+default 1; ``CEP_BENCH_ADAPT_{K,T,CHUNK,REPS,DRIFT_B}`` size it),
 ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
@@ -816,9 +819,12 @@ def bench_tier():
     zero = all(v == 0 for v in uc.values()) and all(
         v == 0 for v in tc.values()
     )
-    dispatch_frac = (
-        tb.nfa_dispatches / tb.scan_calls if tb.scan_calls else 0.0
-    )
+    # Denominator: under chunk-level gating (ISSUE 16) each scan offers
+    # ceil(T'/gate_chunk) device-gated chunks, so the dispatched fraction
+    # is per-chunk whenever the gate ran; pure-NFA plans and the
+    # whole-scan kernel count whole batches (gate_chunks stays 0).
+    gate_denom = tb.gate_chunks or tb.scan_calls
+    dispatch_frac = tb.nfa_dispatches / gate_denom if gate_denom else 0.0
     out = {
         "k": K, "t": T, "chunk": chunk,
         "plan": tb.plan.describe(),
@@ -840,8 +846,324 @@ def bench_tier():
         f"p={tb.plan.prefix_len}): untiered {K * T / ubest / 1e3:.0f}K "
         f"ev/s vs tiered {K * T / tbest / 1e3:.0f}K ev/s "
         f"({ubest / tbest:.2f}x); screened {out['screened_fraction']}, "
-        f"NFA dispatched {dispatch_frac:.1%} of batches, "
+        f"NFA dispatched {dispatch_frac:.1%} of gated chunks, "
         f"{un} vs {tn} match slots (parity={parity}, zero={zero})"
+    )
+    return out
+
+
+def bench_adapt():
+    """``CEP_BENCH_ADAPT``: adaptive recompilation A/B (ISSUE 16).
+
+    Two probes:
+
+    1. *Hybrid sweep* — PROFILE_r09 §2's band re-run under the
+       chunk-gated scan (the per-scan host gate is gone): 4-stage
+       patterns with the first p of 4 stages strict, p = 1..3, untiered
+       vs tiered at identical shapes/cadence.  Every point must sit at
+       or above BENCH_r06's recorded 2.7-5.2x band, loss-free with
+       match parity.
+    2. *Drift A/B* — a two-conjunct workload whose accept mix inverts
+       mid-stream, run twice on identical records: a supervised
+       processor with ``AdaptPolicy`` (profiler-driven replans at
+       checkpoint boundaries) vs the same supervisor with replanning
+       off (the stale compile-time plan).  The adaptive side must fire
+       >= 1 replan, stay bit-identical on matches and loss counters
+       (exactly-once across the swap), and beat the stale declaration
+       order on the lazy-chain objective — expected conjunct
+       evaluation cost per event under the drifted mix (arxiv
+       1612.05110's ranking quantity, computed from the measured
+       marginal selectivities).  Wall-clock is reported for both sides
+       but expected to tie: the array engine evaluates conjunct chains
+       branch-free, so evaluation order is a host/short-circuit and
+       future-gating lever, not a device-throughput one
+       (PROFILE_r09 §3).
+
+    ``CEP_BENCH_ADAPT_{K,T,CHUNK,REPS}`` size the sweep;
+    ``CEP_BENCH_ADAPT_DRIFT_B`` sizes the drift stream (batches per
+    phase).
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from kafkastreams_cep_tpu.parallel.tiered import TieredBatchMatcher
+    from kafkastreams_cep_tpu.pattern.predicate import and_, hint
+    from kafkastreams_cep_tpu.runtime import Record
+    from kafkastreams_cep_tpu.runtime.supervisor import (
+        AdaptPolicy,
+        Supervisor,
+    )
+
+    K = int(os.environ.get("CEP_BENCH_ADAPT_K", "32"))
+    T = int(os.environ.get("CEP_BENCH_ADAPT_T", "2048"))
+    chunk = int(os.environ.get("CEP_BENCH_ADAPT_CHUNK", "128"))
+    reps = int(os.environ.get("CEP_BENCH_ADAPT_REPS", "2"))
+
+    # -- probe 1: hybrid sweep (strict-prefix length 1..3 of 4) ----------
+    def sweep_pattern(p):
+        q = Query()
+        for i, (nm, code) in enumerate(
+            zip(("pa", "pb", "pc", "sd"), (1, 2, 3, 7))
+        ):
+            q = q.select(nm) if i == 0 else q.then().select(nm)
+            if i >= p:
+                q = q.skip_till_next_match()
+            q = q.where(lambda k, v, ts, st, c=code: v == c)
+        return q.build()
+
+    # dewey_depth 24: at 12 the seed-29 trace ticks ver_overflows (both
+    # sides identically), and the loss contract here is all-zero.
+    cfg = EngineConfig(
+        max_runs=32, slab_entries=64, slab_preds=8, dewey_depth=24,
+        max_walk=12,
+    )
+    tcfg = dataclasses.replace(cfg, tiering=True)
+    rng = np.random.default_rng(29)
+    codes = rng.integers(8, 64, size=(K, T)).astype(np.int32)
+    n_chunks = max(T // chunk, 1)
+    hot_chunks = sorted(
+        rng.choice(n_chunks, size=min(3, n_chunks), replace=False)
+    )
+    for i in range(9):
+        c = int(hot_chunks[i % len(hot_chunks)])
+        k = int(rng.integers(0, K))
+        t = c * chunk + int(rng.integers(0, max(chunk - 16, 1)))
+        codes[k, t], codes[k, t + 1], codes[k, t + 2] = 1, 2, 3
+        codes[k, t + 9] = 7
+    events = EventBatch(
+        key=jnp.zeros((K, T), jnp.int32),
+        value=jnp.asarray(codes),
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+
+    def _chunked_scan_adapt(batch):
+        state = batch.init_state()
+        n = 0
+        hits = []
+        for t0 in range(0, T, chunk):
+            ev = jax.tree_util.tree_map(
+                lambda x: x[:, t0:t0 + chunk], events
+            )
+            state, out = batch.scan(state, ev)
+            n += int(jnp.sum(out.count > 0))
+            ct = np.asarray(out.count)
+            for k, t, r in zip(*np.nonzero(ct)):
+                hits.append((int(k), t0 + int(t), int(ct[k, t, r])))
+        jax.block_until_ready(
+            state.slab.stage
+            if not hasattr(state, "engine")
+            else state.engine.slab.stage
+        )
+        return state, n, sorted(hits)
+
+    sweep = {}
+    sweep_parity = True
+    sweep_zero = True
+    for p in (1, 2, 3):
+        pattern = sweep_pattern(p)
+        runs = {}
+        for label, b in (
+            ("untiered", BatchMatcher(pattern, K, cfg)),
+            ("tiered", TieredBatchMatcher(pattern, K, tcfg)),
+        ):
+            state, n, hits = _chunked_scan_adapt(b)  # compile + first
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                state, n, hits = _chunked_scan_adapt(b)
+                best = min(best, time.perf_counter() - t0)
+            runs[label] = (b, state, n, hits, best)
+        ub, us, un, uh, ubest = runs["untiered"]
+        tb, ts_, tn, th, tbest = runs["tiered"]
+        uc, tc = ub.counters(us), tb.counters(ts_)
+        parity = uh == th and uc == tc
+        zero = all(v == 0 for v in uc.values()) and all(
+            v == 0 for v in tc.values()
+        )
+        sweep_parity &= parity
+        sweep_zero &= zero
+        gate_denom = tb.gate_chunks or tb.scan_calls
+        sweep[f"p{p}"] = {
+            "plan": tb.plan.describe(),
+            "untiered_evps": round(K * T / ubest, 1),
+            "tiered_evps": round(K * T / tbest, 1),
+            "speedup": round(ubest / tbest, 3),
+            "nfa_dispatch_fraction": round(
+                tb.nfa_dispatches / gate_denom if gate_denom else 0.0, 4
+            ),
+            "match_slots": un,
+            "match_parity": bool(parity),
+            "counters_zero": bool(zero),
+        }
+        log(
+            f"adapt sweep p={p}: untiered {K * T / ubest / 1e3:.1f}K "
+            f"ev/s vs tiered {K * T / tbest / 1e3:.1f}K ev/s "
+            f"({ubest / tbest:.2f}x, parity={parity}, zero={zero})"
+        )
+        del runs, ub, tb, us, ts_
+
+    # -- probe 2: drift A/B (replanning vs the stale plan) ---------------
+    DK = 8
+    n_phase = int(os.environ.get("CEP_BENCH_ADAPT_DRIFT_B", "16"))
+    batch_sz = 64  # records per process() call, per key below
+
+    def f_narrow(k, v, ts, st):
+        return v < 8
+
+    def g_mod(k, v, ts, st):
+        return v % 4 == 0
+
+    drift_pattern = (
+        Query()
+        .select("first")
+        # Declared order (f, g): equal costs, so only measured
+        # selectivity can flip the chain — exactly what the drift does.
+        .where(and_(hint(f_narrow, cost=4.0), hint(g_mod, cost=4.0)))
+        .then()
+        .select("second").skip_till_next_match()
+        .where(lambda k, v, ts, st: v == 0)
+        .build()
+    )
+    dcfg = EngineConfig(
+        max_runs=32, slab_entries=96, slab_preds=12, dewey_depth=48,
+        max_walk=12, tiering=True, stage_attribution=True,
+    )
+    # Phase 1: {0,4,8,12} -> sel(f)=0.5, sel(g)=1.0 (declared order
+    # already optimal).  Phase 2: {0,1,2,3,5,6,7} -> sel(f)=1.0,
+    # sel(g)=1/7 — the cheap-reject conjunct is now g, so the measured
+    # plan flips the chain.  Phase 2 keeps an occasional 0 so pending
+    # skip-till runs can still complete: a 0-free phase leaves every
+    # open run skipping all phase-2 events and overflows dewey versions.
+    rng2 = np.random.default_rng(41)
+    pools = [(0, 4, 8, 12), (0, 1, 2, 3, 5, 6, 7)]
+    batches = []
+    t_base = 0
+    for phase, pool in enumerate(pools):
+        for _ in range(n_phase):
+            recs = []
+            for i in range(batch_sz):
+                k = int(rng2.integers(0, DK))
+                v = int(rng2.choice(pool))
+                recs.append(Record(k, v, 1000 + t_base + i))
+            t_base += batch_sz
+            batches.append(recs)
+
+    def run_side(policy):
+        d = tempfile.mkdtemp(prefix="cep_adapt_")
+        try:
+            sup = Supervisor(
+                drift_pattern, DK, dcfg,
+                checkpoint_path=os.path.join(d, "ckpt"),
+                checkpoint_every=2,
+                adapt_policy=policy,
+                gc_interval=0,
+            )
+            matches = []
+            # host-timed: end-to-end supervisor records/s — decode pulls
+            # every match to host, and the replan rebuild cost is part
+            # of what this A/B measures.
+            t0 = time.perf_counter()  # host-timed
+            for recs in batches:
+                matches.extend(sup.process(recs))
+            matches.extend(sup.drain_ingest())
+            wall = time.perf_counter() - t0
+            snap = sup.metrics_snapshot()
+            order = [
+                r["order"]
+                for r in (sup.processor.batch.lazy_order or {}).values()
+                if r.get("order")
+            ]
+            counters = sup.processor.counters()
+            return matches, wall, snap, order, counters
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    policy = AdaptPolicy(
+        drift_threshold=0.2, min_evals=64, replan_streak=1, cooldown=0
+    )
+    a_matches, a_wall, a_snap, a_order, a_counters = run_side(policy)
+    s_matches, s_wall, s_snap, s_order, s_counters = run_side(None)
+
+    def keyed(ms):
+        return sorted(
+            (k, tuple(
+                (stg, tuple(e.offset for e in evs))
+                for stg, evs in s.as_map().items()
+            ))
+            for k, s in ms
+        )
+
+    drift_parity = keyed(a_matches) == keyed(s_matches)
+    loss_names = (
+        "run_drops", "ver_overflows", "slab_full_drops",
+        "slab_pred_drops", "slab_trunc", "walk_collisions",
+        "handle_overflows",
+    )
+    drift_zero = all(
+        c.get(n_, 0) == 0
+        for c in (a_counters, s_counters)
+        for n_ in loss_names
+    )
+    n_records = len(batches) * batch_sz
+
+    # Lazy-chain objective under the drifted (phase 2) mix: expected
+    # per-event evaluation cost of each side's live chain order, using
+    # the true marginal selectivities of the drifted pool.  Short-
+    # circuit cost of order (c1, c2) = c1 + sel1 * c2.
+    pool2 = np.asarray(pools[1])
+    sel2 = {
+        "f_narrow": float(np.mean(pool2 < 8)),
+        "g_mod": float(np.mean(pool2 % 4 == 0)),
+    }
+    cost = {"f_narrow": 4.0, "g_mod": 4.0}
+
+    def chain_cost(order_labels):
+        total, reach = 0.0, 1.0
+        for lbl in order_labels:
+            name = "f_narrow" if "f_narrow" in lbl else "g_mod"
+            total += reach * cost[name]
+            reach *= sel2[name]
+        return total
+
+    stale_first = next(
+        (o for o in s_order if len(o) == 2), ["f_narrow", "g_mod"]
+    )
+    adapt_first = next(
+        (o for o in a_order if len(o) == 2), stale_first
+    )
+    stale_cost = chain_cost(stale_first)
+    adapt_cost = chain_cost(adapt_first)
+    out = {
+        "sweep": sweep,
+        "sweep_speedup_min": min(s["speedup"] for s in sweep.values()),
+        "band_r06": [2.7, 5.2],
+        "drift": {
+            "k": DK,
+            "batches": len(batches),
+            "records": n_records,
+            "adaptive_rps": round(n_records / a_wall, 1),
+            "stale_rps": round(n_records / s_wall, 1),
+            "replans": a_snap.get("replans", 0),
+            "replan_failures": a_snap.get("replan_failures", 0),
+            "stale_order": stale_first,
+            "replanned_order": adapt_first,
+            "stale_cost_per_event": round(stale_cost, 3),
+            "replanned_cost_per_event": round(adapt_cost, 3),
+            "lazy_cost_ratio": round(stale_cost / adapt_cost, 3),
+        },
+        "match_parity": bool(sweep_parity and drift_parity),
+        "counters_zero": bool(sweep_zero and drift_zero),
+    }
+    log(
+        f"adapt drift (K={DK}, {n_records} records): adaptive "
+        f"{n_records / a_wall / 1e3:.1f}K rec/s ({a_snap.get('replans', 0)} "
+        f"replans) vs stale {n_records / s_wall / 1e3:.1f}K rec/s; "
+        f"lazy-chain cost {stale_cost:.2f} -> {adapt_cost:.2f} "
+        f"({stale_cost / adapt_cost:.2f}x better on the drifted mix); "
+        f"parity={drift_parity}, zero={drift_zero}"
     )
     return out
 
@@ -1883,6 +2205,7 @@ def main():
     ooo = {}
     tier = {}
     tenants = {}
+    adapt = {}
 
     def _shard_fault_block():
         # Nested under ``resilience`` so the JSON groups every
@@ -1903,6 +2226,14 @@ def main():
                 lambda: tier.update(
                     bench_tier()
                     if os.environ.get("CEP_BENCH_TIER", "1") == "1"
+                    else {}
+                ),
+            ),
+            (
+                "adapt",
+                lambda: adapt.update(
+                    bench_adapt()
+                    if os.environ.get("CEP_BENCH_ADAPT", "1") == "1"
                     else {}
                 ),
             ),
@@ -2077,6 +2408,12 @@ def main():
                 # speedup, match parity, loss flags (None when extras
                 # skipped or CEP_BENCH_TENANTS=0).
                 "tenants": tenants or None,
+                # Adaptive recompilation (ISSUE 16): hybrid sweep under
+                # the chunk-gated scan vs BENCH_r06's 2.7-5.2x band +
+                # drift A/B (AdaptPolicy replans vs the stale plan) —
+                # parity, loss flags, replan count, lazy-chain cost win
+                # (None when extras skipped or CEP_BENCH_ADAPT=0).
+                "adapt": adapt or None,
             }
         ),
         flush=True,

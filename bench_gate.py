@@ -16,10 +16,11 @@ than the trajectory's best on any guarded metric:
   widens its own tolerance instead of flapping the gate.
 * **Loss metrics** (must not degrade): the boolean flags
   (``lossfree_counters_zero``, ``lossfree_oracle_parity``, the
-  ``tier_*`` parity pair, and the ``shard_*`` fault-tolerance pair —
-  evacuation parity and the rebalance loss contract) may not go
-  true→false; ``recall_sampled`` may not drop by more than the same
-  relative tolerance.
+  ``tier_*`` parity pair, the ``shard_*`` fault-tolerance pair —
+  evacuation parity and the rebalance loss contract — and the
+  ``adapt_*`` pair — replan match parity and drift-A/B loss flags) may
+  not go true→false; ``recall_sampled`` may not drop by more than the
+  same relative tolerance.
 
 Missing metrics are skipped on either side (early rounds carry fewer
 keys), so the gate accepts the existing r01→r05 trajectory replayed
@@ -58,6 +59,8 @@ FLAG_METRICS = (
     "shard_rebalance_lossfree",
     "tenant_match_parity",
     "tenant_loss_flags",
+    "adapt_match_parity",
+    "adapt_loss_flags",
 )
 #: Ratio metrics guarded like rates (0..1, higher is better).
 RATIO_METRICS = ("recall_sampled",)
@@ -96,6 +99,13 @@ def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         # and its all-counters-zero flag may never regress true -> false.
         flat["tenant_match_parity"] = tenants.get("match_parity")
         flat["tenant_loss_flags"] = tenants.get("counters_zero")
+    adapt = parsed.get("adapt")
+    if isinstance(adapt, dict):
+        # Nested adapt block (BENCH_r08+) -> flat ``adapt_*`` keys: the
+        # hybrid-sweep + drift-A/B parity (replanned matches bit-equal
+        # to the stale plan's) and the all-loss-counters-zero flag.
+        flat["adapt_match_parity"] = adapt.get("match_parity")
+        flat["adapt_loss_flags"] = adapt.get("counters_zero")
     for k in FLAG_METRICS:
         v = flat.get(k)
         if isinstance(v, bool):

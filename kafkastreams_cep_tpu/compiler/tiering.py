@@ -218,10 +218,41 @@ def predicate_cost(matcher: Matcher) -> float:
     return float(len(code.co_code))
 
 
-def _conjunct_selectivity(m: Matcher, stage_sel: Optional[float]) -> float:
-    """Estimated accept fraction of one conjunct: its declared hint, else
-    the stage's measured selectivity (every conjunct of the stage then
-    ties and cost alone decides), else 0.5."""
+def conjunct_key(m: Matcher) -> str:
+    """A stable, order-invariant identifier for one conjunct.
+
+    Labels alone collide (every bare lambda is ``<lambda>``), and a
+    positional suffix would change under reordering — breaking both the
+    measured-selectivity lookup and the reorder-invariance of the
+    attribution report.  The label is therefore disambiguated by the
+    closure's code location, which is identical however the conjunction
+    is ordered and across rebuilds of the same pattern object."""
+    code = getattr(m.fn, "__code__", None)
+    if code is None:
+        return m.label
+    import os as _os
+
+    return (
+        f"{m.label}@{_os.path.basename(code.co_filename)}"
+        f":{code.co_firstlineno}"
+    )
+
+
+def _conjunct_selectivity(
+    m: Matcher,
+    stage_sel: Optional[float],
+    conjunct_sel: Optional[Dict[str, float]] = None,
+) -> float:
+    """Estimated accept fraction of one conjunct.  Preference order:
+    the *measured* per-conjunct selectivity (the ``[P]`` tally rows a
+    ``stage_attribution`` run accumulates — ranking then rests on
+    measurement alone, no annotations needed), else the declared
+    ``selectivity_hint``, else the stage's measured selectivity (every
+    conjunct of the stage then ties and cost alone decides), else 0.5."""
+    if conjunct_sel:
+        s = conjunct_sel.get(conjunct_key(m))
+        if s is not None:
+            return float(s)
     if getattr(m, "selectivity_hint", None) is not None:
         return float(m.selectivity_hint)
     if stage_sel is not None:
@@ -230,7 +261,9 @@ def _conjunct_selectivity(m: Matcher, stage_sel: Optional[float]) -> float:
 
 
 def order_conjuncts(
-    matcher: Matcher, stage_sel: Optional[float] = None
+    matcher: Matcher,
+    stage_sel: Optional[float] = None,
+    conjunct_sel: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[Matcher], bool]:
     """The lazy-chain order for one stage predicate: conjuncts ranked by
     estimated ``selectivity × cost`` ascending (cheap selective gates
@@ -242,7 +275,7 @@ def order_conjuncts(
     ranked = sorted(
         range(len(parts)),
         key=lambda i: (
-            _conjunct_selectivity(parts[i], stage_sel)
+            _conjunct_selectivity(parts[i], stage_sel, conjunct_sel)
             * predicate_cost(parts[i]),
             i,
         ),
@@ -274,6 +307,107 @@ def _ordered_and(parts: List[Matcher]) -> Matcher:
     return m
 
 
+# ---------------------------------------------------------------------------
+# Measured per-conjunct selectivity (the tally stage_attribution accumulates)
+# ---------------------------------------------------------------------------
+
+
+def conjunct_tally_plan(
+    tables: TransitionTables,
+) -> List[Tuple[str, str, Matcher]]:
+    """The flat conjunct slot layout for ``tables``: one
+    ``(stage_name, key, matcher)`` triple per distinct conjunct of each
+    consuming-edge predicate, declaration-ordered.  Duplicate keys within
+    a stage (the same closure declared twice in one conjunction) collapse
+    to a single slot, so the layout — and therefore the tally report —
+    is invariant under lazy-chain reordering of any stage's chain."""
+    tables = (
+        tables if isinstance(tables, TransitionTables) else lower(tables)
+    )
+    slots: List[Tuple[str, str, Matcher]] = []
+    n = tables.num_stages - 1
+    for j in range(n):
+        pid = int(tables.consume_pred[j])
+        if pid < 0:
+            continue
+        name = tables.names[j]
+        seen = set()
+        for m in conjuncts(tables.predicates[pid]):
+            key = conjunct_key(m)
+            if key in seen:
+                continue
+            seen.add(key)
+            slots.append((name, key, m))
+    return slots
+
+
+def build_conjunct_tally(tables: TransitionTables):
+    """A jit-able accumulator for *measured* per-conjunct selectivity.
+
+    Returns ``(slots, tally)`` where ``slots`` is
+    :func:`conjunct_tally_plan`'s layout and ``tally(counts, ev)`` adds
+    one ``[K, T]`` :class:`EventBatch`'s contribution to a ``[2, P]``
+    int32 counts array — row 0 the valid events each conjunct was
+    offered (identical across slots), row 1 each conjunct's accepts.
+    Every conjunct is evaluated *unconditionally* over the whole batch
+    against the declared fold-state inits (the stencil tier's evaluation
+    context, ``engine/stencil.py``), so the measured selectivity is the
+    order-independent marginal accept fraction — the quantity the
+    lazy-chain ranking needs, not the short-circuit-conditioned rate the
+    sequential engine step observes.  ``tally`` is a pure device
+    function; callers accumulate asynchronously and ``device_get`` only
+    at telemetry reads."""
+    import jax.numpy as jnp
+
+    from kafkastreams_cep_tpu.engine.matcher import ArrayStates
+
+    tables = (
+        tables if isinstance(tables, TransitionTables) else lower(tables)
+    )
+    slots = conjunct_tally_plan(tables)
+    matchers = [m for _, _, m in slots]
+    states = ArrayStates(
+        {
+            name: (
+                jnp.asarray(init, jnp.float32)
+                if dt == "float32"
+                else jnp.asarray(init, jnp.int32)
+            )
+            for name, init, dt in zip(
+                tables.state_names, tables.state_inits, tables.state_dtypes
+            )
+        }
+    )
+
+    def tally(counts, ev):
+        if not matchers:
+            return counts
+        valid = jnp.asarray(ev.valid, bool)
+        evals = jnp.sum(valid.astype(jnp.int32))
+        accepts = jnp.stack(
+            [
+                jnp.sum(
+                    (
+                        jnp.broadcast_to(
+                            jnp.asarray(
+                                m(ev.key, ev.value, ev.ts, states), bool
+                            ),
+                            valid.shape,
+                        )
+                        & valid
+                    ).astype(jnp.int32)
+                )
+                for m in matchers
+            ]
+        )
+        delta = jnp.stack(
+            [jnp.full((len(matchers),), evals, jnp.int32), accepts]
+        )
+        return counts + delta
+
+    return slots, tally
+
+
 def apply_lazy_order(
     tables: TransitionTables, profile: Optional[Dict] = None
 ) -> Tuple[TransitionTables, Dict[str, Any]]:
@@ -300,15 +434,37 @@ def apply_lazy_order(
             continue
         name = tables.names[j]
         stage_sel = None
+        conjunct_sel: Optional[Dict[str, float]] = None
         if profile and name in profile:
             row = profile[name]
-            stage_sel = row.get("selectivity") if isinstance(row, dict) else None
-        ordered, changed = order_conjuncts(preds[pid], stage_sel)
+            if isinstance(row, dict):
+                stage_sel = row.get("selectivity")
+                cj = row.get("conjuncts")
+                if isinstance(cj, dict):
+                    # Measured per-conjunct rows (build_conjunct_tally via
+                    # stage_attribution): {key: {"selectivity": s, ...}}
+                    # or a bare {key: s} mapping.
+                    conjunct_sel = {
+                        k: float(
+                            v.get("selectivity")
+                            if isinstance(v, dict)
+                            else v
+                        )
+                        for k, v in cj.items()
+                        if (
+                            v.get("selectivity")
+                            if isinstance(v, dict)
+                            else v
+                        )
+                        is not None
+                    }
+        ordered, changed = order_conjuncts(preds[pid], stage_sel, conjunct_sel)
         report[name] = {
             "order": [m.label for m in ordered],
             "costs": [round(predicate_cost(m), 1) for m in ordered],
             "reordered": changed,
             "selectivity": stage_sel,
+            "measured_conjuncts": sorted(conjunct_sel) if conjunct_sel else [],
         }
         if changed:
             preds[pid] = _ordered_and(ordered)

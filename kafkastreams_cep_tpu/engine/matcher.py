@@ -206,6 +206,17 @@ class EngineConfig:
     # Semantic for state *shape* (the tiered state carries the stencil
     # carry), so migration must not flip it (runtime/migrate.py).
     tiering: bool = False
+    # Hybrid-tier gating granularity (events per device-gated segment of
+    # the chunked hybrid scan, parallel/tiered.py): the [K, T] batch is
+    # segmented at promotion boundaries and each segment's NFA work runs
+    # under a device-side ``lax.cond`` — a segment with no live suffix
+    # run and no prefix completion is skipped on device (step_seq += C in
+    # one op), so the scan issues zero host syncs.  Pure performance
+    # knob: any value yields bit-identical results (the skip is exact),
+    # so migration/replanning may change it freely (NOT in
+    # _SEMANTIC_FLAGS).  Smaller chunks skip more NFA work on screened
+    # traffic; larger chunks amortize the per-segment gate.
+    gate_chunk: int = 32
 
 
 class EventBatch(NamedTuple):

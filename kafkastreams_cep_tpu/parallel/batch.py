@@ -391,6 +391,36 @@ class BatchMatcher:
                 lambda: jax.jit(self._scan_fn),
             )
         )
+        # Measured per-conjunct selectivity: under stage_attribution every
+        # consuming-edge conjunct is tallied unconditionally over each
+        # scanned batch (compiler/tiering.py: build_conjunct_tally) so
+        # apply_lazy_order can rank lazy chains on measurement alone.
+        # Accumulation is device-side and asynchronous; the counts sync to
+        # host only at telemetry reads (conjunct_counters).  The slot-key
+        # tuple joins the cache tag because the tally closes over this
+        # instance's conjunct order, which lazy reordering permutes.
+        self._conjunct_slots: list = []
+        self._conjunct_counts = None
+        if self.matcher.config.stage_attribution:
+            from kafkastreams_cep_tpu.compiler.tiering import (
+                build_conjunct_tally,
+            )
+
+            slots, tally = build_conjunct_tally(self.matcher.tables)
+            if slots:
+                self._conjunct_slots = slots
+                self._conjunct_tally_jit = self._cached(
+                    "batch.conjunct_tally",
+                    ("tally",) + tuple(k for _, k, _ in slots),
+                    lambda: jax.jit(tally),
+                )
+                inner_scan = self.scan
+
+                def _scan_tallied(state, events):
+                    self._accumulate_conjuncts(events)
+                    return inner_scan(state, events)
+
+                self.scan = _scan_tallied
 
     def _cached(self, namespace: str, tag, build):
         """Jitted-program lookup in the process trace cache, keyed by this
@@ -567,16 +597,57 @@ class BatchMatcher:
             for n, v in per_lane_counter_arrays(state).items()
         }
 
+    def _accumulate_conjuncts(self, events: EventBatch) -> None:
+        """Fold one batch into the device-side conjunct tally.  Pure
+        async device work — no host sync (``conjunct_counters`` syncs)."""
+        if not self._conjunct_slots:
+            return
+        if self._conjunct_counts is None:
+            self._conjunct_counts = jnp.zeros(
+                (2, len(self._conjunct_slots)), jnp.int32
+            )
+        self._conjunct_counts = self._conjunct_tally_jit(
+            self._conjunct_counts, events
+        )
+
+    def conjunct_counters(self) -> Dict[str, Dict[str, Dict[str, Any]]]:
+        """Measured per-conjunct tallies: ``{stage: {conjunct_key:
+        {evals, accepts, selectivity}}}``.  Selectivity is the marginal
+        (order-independent) accept fraction — the ranking signal
+        ``apply_lazy_order`` consumes; ``None`` before any batch.  Empty
+        unless ``stage_attribution`` is on."""
+        import numpy as np
+
+        if not self._conjunct_slots:
+            return {}
+        if self._conjunct_counts is None:
+            counts = np.zeros((2, len(self._conjunct_slots)), np.int64)
+        else:
+            counts = np.asarray(jax.device_get(self._conjunct_counts))
+        report: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        for i, (stage, key, _m) in enumerate(self._conjunct_slots):
+            ev, ac = int(counts[0, i]), int(counts[1, i])
+            report.setdefault(stage, {})[key] = {
+                "evals": ev,
+                "accepts": ac,
+                "selectivity": (ac / ev) if ev else None,
+            }
+        return report
+
     def stage_counters(self, state: EngineState) -> Dict[str, Dict[str, int]]:
         """Per-stage selectivity/cost attribution summed over all lanes
-        (``{stage_name: {tally: total, selectivity}}``); empty when
-        ``EngineConfig.stage_attribution`` is off."""
+        (``{stage_name: {tally: total, selectivity}}``, plus a
+        ``"conjuncts"`` sub-report of measured per-conjunct tallies);
+        empty when ``EngineConfig.stage_attribution`` is off."""
         from kafkastreams_cep_tpu.engine.matcher import (
             stage_counter_arrays,
             stage_report,
         )
 
-        return stage_report(stage_counter_arrays(state), self.names)
+        report = stage_report(stage_counter_arrays(state), self.names)
+        for stage, rows in self.conjunct_counters().items():
+            report.setdefault(stage, {})["conjuncts"] = rows
+        return report
 
     def metrics_snapshot(self, state: EngineState) -> Dict[str, object]:
         """Engine-level telemetry of ``state`` in one dict: summed drop and

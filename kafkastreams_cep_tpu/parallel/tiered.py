@@ -23,19 +23,32 @@ Drop-in for :class:`~kafkastreams_cep_tpu.parallel.batch.BatchMatcher`
   a stepped empty queue changes nothing but ``step_seq``, which the skip
   path advances by ``T`` in one op.
 
-The gating check costs one scalar ``device_get`` per ``scan`` call (the
-stencil output must be inspected on host to elide the NFA dispatch);
-pipelined processors therefore lose some dispatch/decode overlap under
-tiering — throughput on screened workloads gains far more than the sync
-costs (bench ``CEP_BENCH_TIER``).
+Gating is *chunk-level and fully on device*: the ``[K, T]`` batch is
+segmented into ``EngineConfig.gate_chunk``-sized chunks and each chunk's
+NFA work runs under a ``lax.cond`` — a chunk with no live suffix run and
+no prefix completion advances ``step_seq`` in one op and emits a zero
+output block.  The scan issues **zero per-scan host syncs**: dispatch
+accounting accumulates on device and reaches the host only at telemetry
+reads (:attr:`TieredBatchMatcher.nfa_dispatches`), so pipelined
+processors keep full dispatch/decode overlap under tiering (the old
+design paid one scalar ``device_get`` per scan to decide the skip on
+host).  The skip is exact for any ``gate_chunk``: promotion happens
+*after* the completing step — exactly the untiered schedule — so a
+completion in chunk ``i`` has its first observable NFA effect inside
+chunk ``i`` itself, which the gate (``any(alive) | any(fire)`` over the
+chunk) never skips.
 
 Parity: matches, emission order, and loss counters are bit-identical to
 the untiered engine on loss-free workloads across the jnp and Pallas
 walk-kernel paths (tests/test_tiering.py).  Under ``CEP_SCAN_KERNEL``
-the *hybrid* suffix scan falls back to the per-step kernel path (the
-whole-scan Pallas program cannot take per-step promotion inputs); the
-untiered scan-kernel output is bit-identical to the per-step path, so
-tiered-vs-untiered parity is unaffected.
+the hybrid tier runs a *native tiered whole-scan program*
+(``ops/scan_kernel.py: build_scan(..., promotion=p)``): the stencil
+feed's per-step promotion inputs join the event stream, and the
+promotion's slab writes + run-queue append run as a fused phase after
+the engine phases, gated per step on device — no per-step fallback.  A
+pattern that cannot lower to Mosaic falls back permanently to the
+chunked per-step path (the same failure policy as the untiered kernel,
+``parallel/batch.py: guarded_scan_fallback``).
 """
 
 from __future__ import annotations
@@ -86,12 +99,6 @@ def _bump_engine_jit():
     return jax.jit(lambda eng, t: eng._replace(step_seq=eng.step_seq + t))
 
 
-@functools.lru_cache(maxsize=1)
-def _gate_engine_jit():
-    """Process-wide singleton (pattern-free reduction)."""
-    return jax.jit(lambda alive, fire: jnp.any(alive) | jnp.any(fire))
-
-
 class TieredBatchMatcher:
     """``K`` lanes matched under a compiler tiering plan (one chip).
 
@@ -126,15 +133,20 @@ class TieredBatchMatcher:
         self.inner = BatchMatcher(tables, num_lanes, config)
         self.matcher = self.inner.matcher
         self.uses_walk_kernel = self.inner.uses_walk_kernel
-        self.uses_scan_kernel = False  # the tiered scan is step-driven
+        self.uses_scan_kernel = False
         logger.info(
             "tiered matcher: %s (%s), %d lanes",
             self.plan.tier, self.plan.reason, self.num_lanes,
         )
-        # Host-side dispatch accounting: how often the NFA tier actually
-        # ran (the skip-gate's measurable effect; bench CEP_BENCH_TIER).
+        # Dispatch accounting.  ``scan_calls`` and ``gate_chunks`` are
+        # host integers (pure Python bookkeeping); chunk-level NFA
+        # dispatches accumulate *on device* (``_nfa_chunks_dev``) so the
+        # gated scan stays sync-free — :attr:`nfa_dispatches` folds them
+        # in with a single transfer at telemetry-read time.
         self.scan_calls = 0
-        self.nfa_dispatches = 0
+        self.gate_chunks = 0  # device-gated chunks offered (bench denom)
+        self._nfa_dispatch_host = 0  # whole-batch dispatches (nfa/kernel)
+        self._nfa_chunks_dev = None  # [*] i32 — chunks that ran NFA work
         p = self.plan.prefix_len
         if self.plan.tier == TIER_NFA:
             self._prefix = None
@@ -148,14 +160,35 @@ class TieredBatchMatcher:
                         stencil_step_output(tables, config, p)
                     ),
                 )
-            if self.inner.uses_scan_kernel:
-                # The whole-scan Pallas program has no per-step promotion
-                # inputs; the per-step (kernel or jnp) path is bit-
-                # identical, so the fallback costs nothing but the fusion.
-                logger.warning(
-                    "CEP_SCAN_KERNEL requested but the hybrid tier runs "
-                    "the per-step path (promotions are per-step inputs)"
+            if (
+                self.plan.tier == TIER_HYBRID
+                and self.inner.uses_scan_kernel
+            ):
+                # Native tiered whole-scan program: the promotion feed
+                # joins the event stream and the promotion phase fuses
+                # after the engine phases (ops/scan_kernel.py), gated
+                # per step on device.  Same guarded-fallback policy as
+                # the untiered kernel: only a lowering failure swaps in
+                # the chunked per-step path permanently.
+                import os as _os
+
+                scan_mode = _os.environ.get("CEP_SCAN_KERNEL", "0")
+
+                def _build_tiered_full(scan_mode=scan_mode, p=p):
+                    from kafkastreams_cep_tpu.ops import scan_kernel
+
+                    full = scan_kernel.build_scan(
+                        self.tables, self.matcher.config, promotion=p
+                    )
+                    full.interpret = scan_mode == "interpret"
+                    return jax.jit(full)
+
+                self._kernel_scan_jit = self._cached(
+                    "tiered.scan_kernel", (p, scan_mode),
+                    _build_tiered_full,
                 )
+                self.uses_scan_kernel = True
+                logger.info("tiered matcher: whole-scan kernel enabled")
 
     # -- state ---------------------------------------------------------------
 
@@ -211,12 +244,13 @@ class TieredBatchMatcher:
         full scan of an empty, promotion-free queue would have had."""
         return _bump_engine_jit()
 
-    @property
-    def _gate_jit(self):
-        return _gate_engine_jit()
-
     @functools.cached_property
     def _hybrid_scan_jit(self):
+        """The chunk-gated hybrid scan: ``(eng, events, promo) -> (eng,
+        outs, promoted [K], dispatched)`` — ``dispatched`` the i32 count
+        of chunks whose NFA work actually ran.  Entirely on device: the
+        gate is a ``lax.cond`` per ``gate_chunk``-sized segment, so the
+        host never syncs to decide a skip."""
         if self.inner.uses_walk_kernel:
             base_step = kernel_lane_step(
                 self.matcher._phases, self.inner._kernel_interpret
@@ -224,27 +258,100 @@ class TieredBatchMatcher:
         else:
             base_step = lane_step(self.matcher._step_fn)
         promote_b = jax.vmap(self._promote)
+        cfg = self.matcher.config
+        C = max(int(cfg.gate_chunk), 1)
+        K, R, W = self.num_lanes, cfg.max_runs, cfg.max_walk
+        i32 = jnp.int32
+        tmap = jax.tree_util.tree_map
+
+        def body(s, x):
+            ev, pr = x
+            # Step first, then promote: the prefix completes *at* event
+            # t, and the promoted run first evaluates at t+1 — exactly
+            # the untiered run's schedule.
+            s, out = base_step(s, ev)
+            s, n = promote_b(s, pr.fire, pr.offs, pr.anchor_ts, pr.sver)
+            return s, (out, n)
+
+        def run_chunk(args):
+            s, ev_t, pr_t = args
+            s, (outs, ns) = jax.lax.scan(body, s, (ev_t, pr_t))
+            return s, outs, jnp.sum(ns, axis=0)  # ns: [Tc, K] -> [K]
+
+        def skip_chunk(args):
+            # Exact: a scanned empty, promotion-free queue changes
+            # nothing but step_seq, advanced here in one op.
+            s, ev_t, _pr_t = args
+            Tc = ev_t.ts.shape[0]
+            outs = StepOutput(
+                stage=jnp.full((Tc, K, R, W), -1, i32),
+                off=jnp.full((Tc, K, R, W), -1, i32),
+                count=jnp.zeros((Tc, K, R), i32),
+            )
+            s = s._replace(step_seq=s.step_seq + i32(Tc))
+            return s, outs, jnp.zeros((K,), i32)
+
+        def gated_chunk(s, ev_t, pr_t):
+            # The chunk can observe NFA state iff a suffix run is live
+            # at entry or the prefix completes inside it (promotion is
+            # post-step, so a completion's first effect is in-chunk).
+            needed = jnp.any(s.alive) | jnp.any(pr_t.fire)
+            s, outs, n = jax.lax.cond(
+                needed, run_chunk, skip_chunk, (s, ev_t, pr_t)
+            )
+            return s, outs, n, needed.astype(i32)
 
         def scan(eng: EngineState, events: EventBatch, promo):
             swap = lambda x: jnp.swapaxes(x, 0, 1)
-            ev_t = jax.tree_util.tree_map(swap, events)
-            pr_t = jax.tree_util.tree_map(swap, promo)
+            ev_t = tmap(swap, events)  # leaves [T, K, ...]
+            pr_t = tmap(swap, promo)
+            T = ev_t.ts.shape[0]
+            m, r = divmod(T, C)
+            promoted = jnp.zeros((K,), i32)
+            dispatched = i32(0)
+            parts = []
+            if m:
+                # All full chunks through ONE traced cond body: reshape
+                # to [m, C, ...] and scan chunk-at-a-time.
+                chunked = tmap(
+                    lambda x: x[: m * C].reshape((m, C) + x.shape[1:]),
+                    (ev_t, pr_t),
+                )
 
-            def body(s, x):
-                ev, pr = x
-                # Step first, then promote: the prefix completes *at*
-                # event t, and the promoted run first evaluates at t+1 —
-                # exactly the untiered run's schedule.
-                s, out = base_step(s, ev)
-                s, n = promote_b(s, pr.fire, pr.offs, pr.anchor_ts, pr.sver)
-                return s, (out, n)
+                def outer(s, x):
+                    ev, pr = x
+                    s, outs, n, d = gated_chunk(s, ev, pr)
+                    return s, (outs, n, d)
 
-            eng, (outs, ns) = jax.lax.scan(body, eng, (ev_t, pr_t))
-            outs = jax.tree_util.tree_map(swap, outs)
-            return eng, outs, jnp.sum(ns, axis=0)  # ns: [T, K] -> [K]
+                eng, (outs_c, ns, ds) = jax.lax.scan(outer, eng, chunked)
+                parts.append(
+                    tmap(
+                        lambda x: x.reshape((m * C,) + x.shape[2:]),
+                        outs_c,
+                    )
+                )
+                promoted = promoted + jnp.sum(ns, axis=0)
+                dispatched = dispatched + jnp.sum(ds)
+            if r:
+                # Genuine ragged tail — never padded (padding would tick
+                # step_seq past the batch and break bit-parity).
+                ev_r, pr_r = tmap(lambda x: x[m * C :], (ev_t, pr_t))
+                eng, outs_r, n_r, d_r = gated_chunk(eng, ev_r, pr_r)
+                parts.append(outs_r)
+                promoted = promoted + n_r
+                dispatched = dispatched + d_r
+            outs = (
+                parts[0]
+                if len(parts) == 1
+                else tmap(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *parts
+                )
+            )
+            outs = tmap(swap, outs)  # back to [K, T, ...]
+            return eng, outs, promoted, dispatched
 
         return self._cached(
-            "tiered.hybrid_scan",
+            "tiered.hybrid_scan_chunked",
             (
                 self.plan.prefix_len, self.inner.uses_walk_kernel,
                 self.inner._kernel_interpret,
@@ -252,47 +359,76 @@ class TieredBatchMatcher:
             lambda: jax.jit(scan),
         )
 
-    def _zero_out(self, T: int) -> StepOutput:
-        cfg = self.matcher.config
-        K, R, W = self.num_lanes, cfg.max_runs, cfg.max_walk
-        i32 = jnp.int32
-        return StepOutput(
-            stage=jnp.full((K, T, R, W), -1, i32),
-            off=jnp.full((K, T, R, W), -1, i32),
-            count=jnp.zeros((K, T, R), i32),
-        )
+    @property
+    def nfa_dispatches(self) -> int:
+        """NFA-tier dispatch count: whole-batch dispatches (pure-NFA
+        plans and the tiered whole-scan kernel) plus device-gated chunks
+        that actually ran NFA work.  Reading it is the only host sync in
+        the dispatch accounting (telemetry/bench only — never on the
+        scan path)."""
+        n = self._nfa_dispatch_host
+        if self._nfa_chunks_dev is not None:
+            n += int(jax.device_get(self._nfa_chunks_dev))
+        return n
+
+    def _kernel_scan(self, eng: EngineState, events: EventBatch, promo):
+        """The tiered whole-scan kernel with the guarded permanent
+        fallback (lowering failures only) onto the chunked path."""
+        from kafkastreams_cep_tpu.parallel.batch import is_lowering_error
+
+        try:
+            eng, out, promoted = self._kernel_scan_jit(eng, events, promo)
+            return eng, out, promoted, None
+        except Exception as e:
+            if not is_lowering_error(e):
+                raise
+            logger.warning(
+                "tiered whole-scan kernel failed to lower (%s); falling "
+                "back to the chunk-gated per-step path", e,
+            )
+            self.uses_scan_kernel = False
+            return self._hybrid_scan_jit(eng, events, promo)
 
     def scan(self, state: TieredState, events: EventBatch):
         """One ``[K, T]`` batch through the tier plan.  Same output
-        contract as :meth:`BatchMatcher.scan`; host-gated, so not itself
-        jittable (callers that need a pure jitted scan use the untiered
-        matcher)."""
+        contract as :meth:`BatchMatcher.scan`.  Sync-free: every tier
+        decision is either host-static (the plan) or a device-side
+        ``lax.cond`` (the chunk gate), so pipelined callers keep full
+        dispatch/decode overlap."""
         T = int(events.ts.shape[1])
         self.scan_calls += 1
         if self.plan.tier == TIER_NFA:
-            self.nfa_dispatches += 1
+            self._nfa_dispatch_host += 1
             eng, out = self.inner.scan(state.engine, events)
             return TieredState(eng, state.carry), out
+        # Stencil/hybrid tiers never reach inner.scan, so the measured
+        # conjunct tally (stage_attribution) accumulates here — same
+        # once-per-batch schedule as the untiered matcher.
+        self.inner._accumulate_conjuncts(events)
         carry, promo = self._prefix.scan(state.carry, events)
         if self.plan.tier == TIER_STENCIL:
             out = self._synth(promo)
             eng = self._bump_jit(state.engine, jnp.int32(T))
             return TieredState(eng, carry), out
-        # Hybrid: skip the NFA dispatch outright when nothing can happen
-        # there — no live suffix run and no promotion this batch.  One
-        # scalar sync; the skip is exact (see module docstring).
-        needed = bool(
-            jax.device_get(
-                self._gate_jit(state.engine.alive, promo.fire)
+        if self.uses_scan_kernel:
+            eng, out, promoted, dispatched = self._kernel_scan(
+                state.engine, events, promo
             )
-        )
-        if not needed:
-            eng = self._bump_jit(state.engine, jnp.int32(T))
-            return TieredState(eng, carry), self._zero_out(T)
-        self.nfa_dispatches += 1
-        eng, out, promoted = self._hybrid_scan_jit(
-            state.engine, events, promo
-        )
+        else:
+            eng, out, promoted, dispatched = self._hybrid_scan_jit(
+                state.engine, events, promo
+            )
+        if dispatched is None:
+            # Whole-scan kernel: one launch, gated per step in-program.
+            self._nfa_dispatch_host += 1
+        else:
+            C = max(int(self.matcher.config.gate_chunk), 1)
+            self.gate_chunks += -(-T // C)
+            self._nfa_chunks_dev = (
+                dispatched
+                if self._nfa_chunks_dev is None
+                else self._nfa_chunks_dev + dispatched
+            )
         carry = carry._replace(promotions=carry.promotions + promoted)
         return TieredState(eng, carry), out
 
@@ -338,4 +474,9 @@ class TieredBatchMatcher:
     def metrics_snapshot(self, state: TieredState) -> Dict[str, object]:
         out = self.inner.metrics_snapshot(state.engine)
         out.update(self.tier_counters(state))
+        # Dispatch-gate telemetry (host + one device read, never on the
+        # scan path): how much NFA work the chunk gate actually elided.
+        out["tier_scan_calls"] = self.scan_calls
+        out["tier_gate_chunks"] = self.gate_chunks
+        out["tier_nfa_dispatches"] = self.nfa_dispatches
         return out

@@ -98,6 +98,18 @@ class CEPBank:
                 for metric, v in row.items():
                     if metric == "selectivity":
                         continue
+                    if metric == "conjuncts":
+                        # Sub-report keyed by conjunct: evals/accepts add
+                        # like every other tally; selectivity re-derives
+                        # from the merged totals below.
+                        cd = dst.setdefault("conjuncts", {})
+                        for key, tallies in v.items():
+                            slot = cd.setdefault(
+                                key, {"evals": 0, "accepts": 0}
+                            )
+                            slot["evals"] += tallies["evals"]
+                            slot["accepts"] += tallies["accepts"]
+                        continue
                     dst[metric] = dst.get(metric, 0) + v
         if per_stage:
             for row in per_stage.values():
@@ -105,6 +117,11 @@ class CEPBank:
                 row["selectivity"] = (
                     round(row.get("stage_accepts", 0) / ev, 6) if ev else 0.0
                 )
+                for slot in row.get("conjuncts", {}).values():
+                    slot["selectivity"] = (
+                        (slot["accepts"] / slot["evals"])
+                        if slot["evals"] else None
+                    )
             snap["per_stage"] = per_stage
         snap["per_pattern"] = {
             name: {

@@ -396,6 +396,89 @@ def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
     return new_proc
 
 
+def replan_processor(pattern, proc, profile):
+    """Swap a live tiered :class:`CEPProcessor` onto a re-derived
+    execution plan (adaptive recompilation, ISSUE 16).
+
+    ``profile`` is a measured ``per_stage`` snapshot (optionally carrying
+    per-conjunct rows — ``stage_counters()`` under ``stage_attribution``)
+    that re-runs ``apply_lazy_order``/``plan_tiering`` inside the rebuilt
+    :class:`TieredBatchMatcher`.  Unlike :func:`migrate_processor` the
+    config is *unchanged*: conjunct reordering commutes (property-tested
+    in tests/test_tiering.py) and the tier split is a function of pattern
+    + config alone, so every state array transfers verbatim — no
+    embedding, and matches/emission order/loss counters are invariant to
+    the swap point.  Like every live rebuild, the processor must hold no
+    undecoded pipelined batch (``flush()`` first).
+    """
+    from kafkastreams_cep_tpu.runtime.processor import CEPProcessor
+
+    if getattr(proc, "_pending", None) is not None:
+        raise ValueError(
+            "pipelined processor holds an undecoded batch; call flush() "
+            "before replanning (the old plan owns the in-flight dispatch)"
+        )
+    config = proc.batch.matcher.config
+    if not getattr(config, "tiering", False):
+        raise ValueError("replan_processor requires a tiered processor")
+    # Fault site: a replan that dies here leaves the OLD processor fully
+    # intact — the caller keeps the old plan and nothing is lost.
+    _failpoint("replan.swap")
+    new_proc = CEPProcessor(
+        pattern,
+        proc.num_lanes,
+        config,
+        topic=proc.topic,
+        epoch=proc.epoch,
+        gc_events=proc.gc_events,
+        dedup=proc.dedup,
+        gc_interval=proc.gc_interval,
+        gc_events_interval=proc.gc_events_interval,
+        decode_budget=proc.decode_budget,
+        pipeline=proc.pipeline,
+        drain_interval=proc.drain_interval,
+        mesh=proc.mesh,
+        profile=profile,
+    )
+    if list(new_proc.batch.names) != list(proc.batch.names):
+        raise ValueError(
+            "pattern topology changed across the replan: stages "
+            f"{new_proc.batch.names} vs live {proc.batch.names}"
+        )
+    new_proc.state = new_proc.place(
+        _jax_tree_host(proc.state)
+    )
+    new_proc._lane_of = dict(proc._lane_of)
+    new_proc._key_of = dict(proc._key_of)
+    new_proc._next_offset = proc._next_offset.copy()
+    new_proc._off_base = proc._off_base.copy()
+    new_proc._events = [dict(d) for d in proc._events]
+    new_proc._col_batches = list(proc._col_batches)
+    new_proc._value_proto = proc._value_proto
+    new_proc._step_base = proc._step_base  # pending-handle ordering base
+    new_proc.metrics = proc.metrics  # continuity: one stream, one meter
+    new_proc.flight = proc.flight
+    new_proc._dlq_base = proc._dlq_base
+    new_proc._guard = proc._guard
+    logger.info(
+        "replanned processor: tier=%s lazy_order=%s",
+        new_proc.batch.plan.tier,
+        {
+            s: r.get("order")
+            for s, r in getattr(new_proc.batch, "lazy_order", {}).items()
+            if r.get("reordered")
+        },
+    )
+    return new_proc
+
+
+def _jax_tree_host(state):
+    """Every state leaf as a host numpy array (shape-preserving)."""
+    import jax as _jax
+
+    return _jax.tree_util.tree_map(np.asarray, state)
+
+
 # -- lane repartitioning (shard evacuation / hot-key rebalancing) ------------
 #
 # Why a lane permutation is a pure relabeling (the proof burden)

@@ -46,6 +46,7 @@ from kafkastreams_cep_tpu.runtime.ingest import (
     IngestGuard,
     IngestPolicy,
 )
+from kafkastreams_cep_tpu.utils import tracecache
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 from kafkastreams_cep_tpu.utils.metrics import Metrics, device_memory_stats
@@ -147,7 +148,14 @@ class CEPProcessor:
         drain_interval: int = 1,
         ingest: Optional[IngestPolicy] = None,
         flight=None,
+        profile=None,
     ):
+        # ``profile``: an optional measured ``per_stage`` selectivity
+        # snapshot (``stage_counters()`` of an attribution run) handed to
+        # the tiered matcher's lazy-chain conjunct ordering; ignored
+        # untiered.  The supervisor's adaptive replanner
+        # (runtime/supervisor.py AdaptPolicy) rebuilds the processor with
+        # a fresh measured profile when observed selectivity drifts.
         # ``mesh``: a ``jax.sharding.Mesh`` shards the lane axis over the
         # devices (state-follows-partition, ``CEPProcessor.java:117-134`` —
         # each lane's run queue/slab/folds live on exactly one device for
@@ -160,9 +168,10 @@ class CEPProcessor:
             from kafkastreams_cep_tpu.parallel.sharding import ShardedMatcher
 
             if tiering:
-                # Tiering host-gates the NFA dispatch per batch, which
-                # shard_map cannot express today; refusing beats silently
-                # restoring a tiered checkpoint into an untiered shape.
+                # The tiered matcher's host control flow (per-tier
+                # dispatch selection) is not expressible under shard_map
+                # today; refusing beats silently restoring a tiered
+                # checkpoint into an untiered shape.
                 raise ValueError(
                     "EngineConfig.tiering is single-chip: construct the "
                     "processor without a mesh (or without tiering)"
@@ -173,7 +182,9 @@ class CEPProcessor:
                 TieredBatchMatcher,
             )
 
-            self.batch = TieredBatchMatcher(pattern, num_lanes, config)
+            self.batch = TieredBatchMatcher(
+                pattern, num_lanes, config, profile=profile
+            )
         else:
             self.batch = BatchMatcher(pattern, num_lanes, config)
         self.topic = topic
@@ -1309,6 +1320,11 @@ class CEPProcessor:
                 per_lane_arrays=snap["per_lane"]
             )
         snap["hbm"] = device_memory_stats()
+        # Compiled-program cache health (utils/tracecache.py): entry
+        # count vs capacity plus hit/miss/eviction totals — an eviction
+        # storm here is recompilation thrash, the first thing to check
+        # when adaptive replans or escalations slow a stream down.
+        snap["trace_cache"] = tracecache.stats()
         return snap
 
     def per_key_cost(

@@ -168,6 +168,43 @@ class ShardPolicy:
     rebalance_cooldown: int = 1
 
 
+@dataclass
+class AdaptPolicy:
+    """When the supervisor re-derives the execution plan from *measured*
+    selectivity — adaptive recompilation (ISSUE 16 tentpole part 3), the
+    loop that closes profiler → compiler.
+
+    The compiler's lazy-chain conjunct ordering and tier split
+    (``compiler/tiering.py``) are derived once, from hints or from
+    whatever profile existed at build time.  A stream whose selectivity
+    drifts (the cheap gate stops being selective) leaves that plan
+    stale — correct, but doing the expensive conjunct's work first.  At
+    every checkpoint boundary the supervisor compares the *windowed*
+    per-stage (and per-conjunct, when ``stage_attribution`` tallies
+    them) accept fraction against the selectivity the live plan was
+    derived from; sustained drift triggers
+    ``runtime.migrate.replan_processor`` — re-running
+    ``apply_lazy_order``/``plan_tiering`` over the measured profile and
+    swapping the processor in place.  Conjunct reordering commutes and
+    the state transfers verbatim, so matches, emission order, and loss
+    counters are invariant to the swap point (chaos-tested in
+    tests/test_chaos.py).
+
+    Hysteresis mirrors :class:`ShardPolicy`: a boundary *trips* when any
+    tracked selectivity that saw at least ``min_evals`` windowed
+    evaluations moved more than ``drift_threshold`` (absolute) from its
+    plan-time value; ``replan_streak`` consecutive tripping boundaries
+    (with ``cooldown`` boundaries since the last swap) fire the replan.
+    A swap that fails (``replan.swap`` fault site) leaves the old
+    processor and plan fully intact and counts in ``replan_failures``.
+    """
+
+    drift_threshold: float = 0.25
+    min_evals: int = 256
+    replan_streak: int = 2
+    cooldown: int = 1
+
+
 class Supervisor:
     """Checkpointing, health-probing, auto-recovering processor wrapper.
 
@@ -218,6 +255,7 @@ class Supervisor:
         processor: Optional[CEPProcessor] = None,
         shard_policy: Optional[ShardPolicy] = None,
         shard_probe=None,
+        adapt_policy=None,
         _resuming: bool = False,
         **proc_kwargs,
     ):
@@ -347,6 +385,29 @@ class Supervisor:
         self._hops_base: Optional[np.ndarray] = None
         self._rebalance_streak = 0
         self._boundaries_since_move = 10**9  # no cooldown before 1st move
+        # Adaptive recompilation (AdaptPolicy): ``True`` takes the
+        # defaults, a policy instance tunes the hysteresis, None/False
+        # disables.  Only a tiered processor with ``stage_attribution``
+        # produces the measured signal — the check is a boundary-time
+        # no-op otherwise, so enabling it on any processor is harmless.
+        if adapt_policy is True:
+            self._adapt_policy: Optional[AdaptPolicy] = AdaptPolicy()
+        elif adapt_policy:
+            self._adapt_policy = adapt_policy
+        else:
+            self._adapt_policy = None
+        self.replans = 0
+        self.replan_failures = 0
+        # Selectivity the LIVE plan was derived from ({key: fraction};
+        # None until the first boundary with >= min_evals measured), and
+        # the cumulative (evals, accepts) snapshot at the previous
+        # boundary for the windowed delta.  Both reset on any rollback
+        # rebuild (_restore_tail) — restored processors carry the
+        # default plan and reverted counters.
+        self._plan_sel: Optional[dict] = None
+        self._sel_prev: Optional[dict] = None
+        self._replan_streak = 0
+        self._boundaries_since_replan = 10**9  # no cooldown before 1st
         # After a failed append the on-disk journal is no longer a complete
         # history — appending later batches would leave a seq gap that a
         # resume would replay straight through into a wrong state.  Suspend
@@ -359,7 +420,7 @@ class Supervisor:
         self.trace = self._proc_kwargs.get("trace_sink")
         self.telemetry = MetricsRegistry()
         for _n in ("checkpoint", "recover", "escalate", "evacuate",
-                   "rebalance"):
+                   "rebalance", "replan"):
             self.telemetry.histogram(f"phase.{_n}")
         # Flight recorder (runtime/flight.py): pass ``flight=`` like any
         # processor kwarg; the supervisor owns the dump triggers — crash
@@ -715,6 +776,10 @@ class Supervisor:
             # recovery and resume replays under the new lane assignment.
             if self._shard_policy is not None:
                 self._maybe_rebalance()
+            # Adaptive replan check, same placement for the same reason:
+            # a plan swap landing here is pinned by the snapshot below.
+            if self._adapt_policy is not None:
+                self._maybe_replan(corr)
             # A failed snapshot (disk full, ...) must not lose the batch's
             # matches: the journal still covers everything since the last
             # good snapshot, so log, count, and retry next batch.
@@ -796,6 +861,13 @@ class Supervisor:
         # (suppressed — already emitted) or it would leak into the next
         # real process() call as a duplicate emission.
         self.processor.flush()
+        # Every rollback rebuild (recovery, evacuation, escalation) lands
+        # on the checkpoint-restored processor, which carries the DEFAULT
+        # execution plan and reverted attribution counters — the adaptive
+        # replanner's plan baseline and window snapshot are both stale.
+        self._plan_sel = None
+        self._sel_prev = None
+        self._replan_streak = 0
         return replayed
 
     def _recover(self, corr: Optional[str] = None) -> None:
@@ -1068,6 +1140,145 @@ class Supervisor:
             [h["key"] for h in hot["top"]],
         )
 
+    # -- adaptive recompilation ---------------------------------------------
+
+    @staticmethod
+    def _sel_counts(per_stage: dict) -> dict:
+        """Flatten a ``stage_counters`` snapshot into cumulative
+        ``{key: (evals, accepts)}`` rows — one ``(stage,)`` row per stage
+        and one ``(stage, conjunct_key)`` row per measured conjunct (the
+        exact selectivities ``apply_lazy_order`` would rank by)."""
+        counts: dict = {}
+        for name, row in per_stage.items():
+            if not isinstance(row, dict):
+                continue
+            counts[(name,)] = (
+                int(row.get("stage_evals", 0) or 0),
+                int(row.get("stage_accepts", 0) or 0),
+            )
+            cj = row.get("conjuncts")
+            if isinstance(cj, dict):
+                for key, crow in cj.items():
+                    if isinstance(crow, dict):
+                        counts[(name, key)] = (
+                            int(crow.get("evals", 0) or 0),
+                            int(crow.get("accepts", 0) or 0),
+                        )
+        return counts
+
+    def _maybe_replan(self, corr: Optional[str] = None) -> None:
+        """Swap the processor onto a re-derived execution plan when the
+        measured selectivity has drifted from the plan's assumptions.
+
+        Runs at checkpoint boundaries only (see :class:`AdaptPolicy` for
+        the signal and hysteresis).  The swap is
+        ``migrate.replan_processor`` — config unchanged, state verbatim,
+        matches/emission order/loss counters invariant — and is pinned by
+        the checkpoint that immediately follows in
+        ``_process_supervised``, so recoveries and resumes replay under a
+        *consistent* plan either side of the boundary.  A failed swap
+        (``replan.swap`` fault site) keeps the old processor and plan.
+        """
+        policy = self._adapt_policy
+        if policy is None:
+            return
+        config = self.processor.batch.matcher.config
+        if not getattr(config, "tiering", False):
+            return  # replan_processor requires the tiered matcher
+        per_stage = self.processor.batch.stage_counters(
+            self.processor.state
+        )
+        if not per_stage:
+            return  # stage_attribution off: no measured signal
+        counts = self._sel_counts(per_stage)
+        prev, self._sel_prev = self._sel_prev, counts
+        self._boundaries_since_replan += 1
+        if self._plan_sel is None:
+            # First boundary with measured data: pin the plan baseline
+            # (keys below min_evals stay unpinned until they have seen
+            # enough evaluations to mean anything).
+            self._plan_sel = {
+                key: ac / ev
+                for key, (ev, ac) in counts.items()
+                if ev >= policy.min_evals
+            }
+            return
+        # Late-warming keys join the baseline as they cross min_evals.
+        for key, (ev, ac) in counts.items():
+            if key not in self._plan_sel and ev >= policy.min_evals:
+                self._plan_sel[key] = ac / ev
+        if prev is None:
+            return  # no window yet (first boundary after a rollback)
+        drifted = []
+        for key, (ev, ac) in counts.items():
+            pev, pac = prev.get(key, (0, 0))
+            wev, wac = ev - pev, ac - pac
+            base = self._plan_sel.get(key)
+            # wev < 0: the cumulative tally restarted under this key (a
+            # prior replan resets the conjunct accumulator) — skip until
+            # the window is meaningful again.
+            if base is None or wev < policy.min_evals:
+                continue
+            wsel = wac / wev
+            if abs(wsel - base) > policy.drift_threshold:
+                drifted.append((key, round(base, 4), round(wsel, 4)))
+        if not drifted:
+            self._replan_streak = 0
+            return
+        self._replan_streak += 1
+        if (
+            self._replan_streak < policy.replan_streak
+            or self._boundaries_since_replan <= policy.cooldown
+        ):
+            return
+        with maybe_span(
+            self.trace, "replan", corr=corr, seq=self._seq,
+            drifted=[
+                {"key": "/".join(k), "plan": b, "window": w}
+                for k, b, w in drifted
+            ],
+        ), timed_histogram(self.telemetry, "phase.replan"):
+            if self.processor.pipeline:
+                # An undecoded device batch belongs to the OLD plan's
+                # dispatch; flushing is observable emission, kept for
+                # the caller (same rule as rebalance/checkpoint).
+                self._unclaimed.extend(self.processor.flush())
+            try:
+                self.processor = migrate_mod.replan_processor(
+                    self._pattern, self.processor, per_stage
+                )
+            except Exception:
+                self.replan_failures += 1
+                # replan_processor mutates nothing before it succeeds —
+                # the old processor, plan, and state are fully intact;
+                # skip this boundary and re-measure.
+                logger.exception(
+                    "adaptive replan failed; keeping the current plan"
+                )
+                self._replan_streak = 0
+                return
+            self.processor.trace = self.trace
+            self.processor.flight = self.flight
+            self.replans += 1
+            self._replan_streak = 0
+            self._boundaries_since_replan = 0
+            # The new plan was derived from exactly this profile: its
+            # baseline is the cumulative selectivity at the swap.  The
+            # window snapshot resets — the rebuilt matcher restarts the
+            # per-conjunct accumulator from zero.
+            self._plan_sel = {
+                key: ac / ev
+                for key, (ev, ac) in counts.items()
+                if ev >= policy.min_evals
+            }
+            self._sel_prev = None
+        logger.warning(
+            "adaptive replan #%d: selectivity drift %s (plan -> window); "
+            "plan re-derived from the measured profile",
+            self.replans,
+            [(("/".join(k)), b, w) for k, b, w in drifted],
+        )
+
     # -- elastic capacity escalation ----------------------------------------
 
     def _capacity_counters(self) -> dict:
@@ -1292,6 +1503,8 @@ class Supervisor:
         out["evacuations"] = self.evacuations
         out["rebalances"] = self.rebalances
         out["rebalance_failures"] = self.rebalance_failures
+        out["replans"] = self.replans
+        out["replan_failures"] = self.replan_failures
         out["lanes_moved"] = self.lanes_moved
         out["stragglers"] = self.stragglers
         if self.flight is not None:

@@ -232,6 +232,10 @@ SITES = (
     # and hot-key rebalancing; see the docstring table).
     "shard.dispatch",
     "rebalance.move",
+    # Adaptive replan swap (runtime/supervisor.py _maybe_replan): between
+    # deriving the new plan and committing the rebuilt processor — a
+    # crash here must leave the old plan fully live (replan_failures).
+    "replan.swap",
 )
 
 

@@ -628,6 +628,25 @@ def render_prometheus(
                             v,
                             f'{{stage="{stage}"}}',
                         )
+                    elif cname == "conjuncts" and isinstance(v, dict):
+                        # Measured per-conjunct tallies (lazy-chain
+                        # ranking input): stage+conjunct labeled series.
+                        for ckey in sorted(v):
+                            row = v[ckey]
+                            if not isinstance(row, dict):
+                                continue
+                            for mname in sorted(row):
+                                mv = row[mname]
+                                if isinstance(
+                                    mv, (int, float)
+                                ) and not isinstance(mv, bool):
+                                    scalar(
+                                        f"{prefix}_conjunct_"
+                                        f"{_sanitize(mname)}",
+                                        mv,
+                                        f'{{stage="{stage}",'
+                                        f'conjunct="{ckey}"}}',
+                                    )
         elif key == "per_key" and isinstance(val, dict):
             # Heavy-hitter cost attribution by key (processor
             # ``per_key_cost``): the top-K lanes' walk work as gauges.

@@ -19,7 +19,18 @@ patterns (``tables_key`` returns None) bypass the cache and behave
 exactly as before.
 
 ``CEP_TRACE_CACHE`` controls it: unset/``1`` = on (default capacity
-256 entries, LRU), ``0``/``off`` = disabled, any integer = capacity.
+4096 entries, LRU), ``0``/``off`` = disabled, any integer = capacity.
+
+The default capacity must comfortably exceed the process's *working
+set* of distinct programs, not just bound memory: an LRU swept
+sequentially by a working set even slightly over capacity degrades to
+a 0% hit rate (every entry is evicted just before its next use), which
+here means re-paying full trace cost on nearly every matcher build —
+measured as a 2-3x wall-clock regression across the test suite when
+the set first outgrew the old 256-entry default.  4096 keeps eviction
+a true safety bound (adaptive-replan thrash, pathological pattern
+churn) instead of a steady-state behavior; entries are jitted
+callables, small on host until executed.
 """
 
 from __future__ import annotations
@@ -29,12 +40,13 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Hashable, Optional
 
-_DEFAULT_CAPACITY = 256
+_DEFAULT_CAPACITY = 4096
 
 _lock = threading.Lock()
 _store: "OrderedDict[Hashable, Any]" = OrderedDict()
 _hits = 0
 _misses = 0
+_evictions = 0
 
 
 def capacity() -> int:
@@ -60,7 +72,7 @@ def lookup(
     entries alive; evicted entries simply fall back to garbage
     collection like any un-cached matcher's programs.
     """
-    global _hits, _misses
+    global _hits, _misses, _evictions
     cap = capacity()
     if key is None or cap == 0:
         return build()
@@ -77,6 +89,7 @@ def lookup(
             _store[full] = value
             while len(_store) > cap:
                 _store.popitem(last=False)
+                _evictions += 1
         _store.move_to_end(full)
         return _store[full]
 
@@ -87,14 +100,16 @@ def stats() -> dict:
             "entries": len(_store),
             "hits": _hits,
             "misses": _misses,
+            "evictions": _evictions,
             "capacity": capacity(),
         }
 
 
 def clear() -> None:
     """Drop every cached program (tests; never needed in production)."""
-    global _hits, _misses
+    global _hits, _misses, _evictions
     with _lock:
         _store.clear()
         _hits = 0
         _misses = 0
+        _evictions = 0

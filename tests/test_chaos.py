@@ -347,3 +347,260 @@ def test_shard_chaos_kill_one_tiered_sweep(seed, tmp_path):
     """Shard death + evacuation with the stencil tier live: the moved
     TieredState carry stays bit-identical to the tiered oracle."""
     assert_shard_chaos_invariants(seed, tmp_path, cfg=TIERED_CFG)
+
+
+# -- adaptive replan chaos ----------------------------------------------------
+#
+# The profiler->compiler loop (AdaptPolicy, runtime/supervisor.py): a
+# drifting stream trips a checkpoint-boundary replan that swaps the
+# processor onto a plan re-derived from the measured selectivity profile
+# (migrate.replan_processor).  The swap must be behaviorally invisible —
+# matches, emission, and state identical to a replan-free oracle — no
+# matter where it lands relative to faults, crashes, and resumes, and a
+# swap that dies mid-flight (the ``replan.swap`` fault site) must leave
+# the old plan fully intact.
+
+from kafkastreams_cep_tpu import Query
+from kafkastreams_cep_tpu.runtime.supervisor import AdaptPolicy
+
+# dewey_depth widened for the denser drift stream below — sized so every
+# sweep seed runs overflow-free (chaos isolates plan swaps, not capacity
+# loss; escalation has its own suite).
+ADAPT_CFG = dataclasses.replace(
+    TIERED_CFG, stage_attribution=True, dewey_depth=48
+)
+# Aggressive hysteresis so the short test streams trip: any 5-point
+# windowed drift over >= 2 evals replans at the very next boundary.
+AGGRESSIVE = AdaptPolicy(
+    drift_threshold=0.05, min_evals=2, replan_streak=1, cooldown=0
+)
+
+
+def adapt_pattern():
+    """A conjunct-bearing tiered pattern (declared expensive-first on
+    purpose) so the replan has a lazy chain to re-rank from the measured
+    per-conjunct tallies."""
+    from kafkastreams_cep_tpu.pattern.predicate import and_, hint
+
+    pricey = hint(
+        lambda k, v, ts, st: (v * v + 3 * v) % 97 != 11, cost=50.0
+    )
+    first_is = hint(lambda k, v, ts, st: v == 0, cost=1.0)
+    return (
+        Query()
+        .select("first").where(and_(pricey, first_is))
+        .then()
+        .select("second").skip_till_next_match()
+        .where(lambda k, v, ts, st: v == 1)
+        .build()
+    )
+
+
+def gen_drift_batches(seed, batch_size=2 * BATCH_SIZE):
+    """A seeded stream whose selectivity flips halfway: the first half is
+    dense in matching codes, the second half nearly all noise — exactly
+    the drift AdaptPolicy watches for."""
+    rng = np.random.default_rng(seed)
+    offs = collections.defaultdict(int)
+    batches, t = [], 0
+    n = 2 * N_BATCHES
+    for bi in range(n):
+        pool = (0, 1, 2, 3) if bi < n // 2 else (4, 4, 4, 4, 4, 4, 4, 0)
+        recs = []
+        for _ in range(batch_size):
+            k = KEYS[int(rng.integers(len(KEYS)))]
+            v = int(pool[int(rng.integers(len(pool)))])
+            recs.append(Record(k, v, 1000 + t, offset=offs[k]))
+            offs[k] += 1
+            t += 1
+        batches.append(recs)
+    return batches
+
+
+def oracle_run_pattern(pattern, batches, cfg):
+    """oracle_run over an explicit pattern (the fault-free, replan-free
+    baseline the adaptive runs are compared against)."""
+    proc = CEPProcessor(pattern, len(KEYS), cfg, gc_interval=0)
+    emitted = collections.Counter()
+    for b in batches:
+        for k, seq in proc.process(b):
+            emitted[canon_match(k, seq)] += 1
+    for k, seq in proc.flush():
+        emitted[canon_match(k, seq)] += 1
+    return proc.state, emitted
+
+
+def assert_states_equal(state, want_state, msg):
+    ca = canonical_state(state)
+    cb = canonical_state(want_state)
+    for i, (x, y) in enumerate(
+        zip(jax.tree_util.tree_leaves(ca), jax.tree_util.tree_leaves(cb))
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y),
+            err_msg=f"{msg}: state leaf {i} diverged",
+        )
+
+
+def test_drift_triggers_replan_and_is_invariant(tmp_path):
+    """Drift-then-replan differential (no faults): the flipped stream
+    trips at least one adaptive replan, the swapped-in plan is derived
+    from MEASURED selectivity, and matches + final state are identical
+    to the replan-free oracle — the swap point is unobservable."""
+    batches = gen_drift_batches(7)
+    pat = adapt_pattern()
+    want_state, want_matches = oracle_run_pattern(pat, batches, ADAPT_CFG)
+    sup = Supervisor(
+        pat, len(KEYS), ADAPT_CFG,
+        checkpoint_path=str(tmp_path / "adapt.ckpt"),
+        journal_path=str(tmp_path / "adapt.jrnl"),
+        checkpoint_every=2, gc_interval=0, adapt_policy=AGGRESSIVE,
+    )
+    emitted = collections.Counter()
+    for b in batches:
+        for k, seq in sup.process(b):
+            emitted[canon_match(k, seq)] += 1
+    assert sup.replans >= 1 and sup.replan_failures == 0
+    # The loop actually closed: the live plan was derived from measured
+    # selectivity (the initial build has no profile, so its lazy_order
+    # rows carry selectivity=None and no measured conjuncts).
+    lz = sup.processor.batch.lazy_order
+    assert any(r.get("selectivity") is not None for r in lz.values()), lz
+    assert any(r.get("measured_conjuncts") for r in lz.values()), lz
+    assert emitted == want_matches
+    assert_states_equal(
+        sup.processor.state, want_state, "across the replan swap"
+    )
+    snap = sup.metrics_snapshot(per_lane=False)
+    assert snap["replans"] == sup.replans >= 1
+    assert snap["phases"]["replan"]["count"] == sup.replans
+    assert not any(sup.processor.counters().values())
+
+
+def test_replan_swap_failure_keeps_the_old_plan(tmp_path):
+    """A replan that dies at the ``replan.swap`` fault site is absorbed:
+    the old processor/plan stay live, the failure is counted, and the
+    stream's matches still equal the oracle's."""
+    batches = gen_drift_batches(11)
+    pat = adapt_pattern()
+    _, want_matches = oracle_run_pattern(pat, batches, ADAPT_CFG)
+    sup = Supervisor(
+        pat, len(KEYS), ADAPT_CFG,
+        checkpoint_path=str(tmp_path / "adaptf.ckpt"),
+        journal_path=str(tmp_path / "adaptf.jrnl"),
+        checkpoint_every=2, gc_interval=0, adapt_policy=AGGRESSIVE,
+    )
+    fp.FAILPOINTS.arm("replan.swap", times=10**9)  # every attempt dies
+    emitted = collections.Counter()
+    try:
+        for b in batches:
+            for k, seq in sup.process(b):
+                emitted[canon_match(k, seq)] += 1
+    finally:
+        fp.FAILPOINTS.clear()
+    assert sup.replans == 0 and sup.replan_failures >= 1
+    # The plan never changed: still the profile-less build.
+    assert all(
+        r.get("selectivity") is None
+        for r in sup.processor.batch.lazy_order.values()
+    )
+    assert emitted == want_matches
+    assert not any(sup.processor.counters().values())
+
+
+REPLAN_FAULTS = FAULTS + (("replan.swap", 0.30, 1),)
+
+
+def run_replan_chaos(seed, tmp_path):
+    """The single-mesh chaos harness over a drifting stream with the
+    adaptive replanner live, fault schedules extended with the
+    ``replan.swap`` site.  Supervisor counters reset on crash, so replan
+    totals accumulate across incarnations."""
+    batches = gen_drift_batches(seed)
+    pat = adapt_pattern()
+    rng = np.random.default_rng(seed + 30_000)
+    ck = str(tmp_path / f"replan{seed}.ckpt")
+    jr = str(tmp_path / f"replan{seed}.jrnl")
+
+    def mk(resume=False):
+        args = (pat, len(KEYS), ADAPT_CFG)
+        kw = dict(
+            checkpoint_path=ck, journal_path=jr, checkpoint_every=2,
+            gc_interval=0, adapt_policy=AGGRESSIVE,
+        )
+        if resume:
+            return Supervisor.resume(*args, **kw)
+        return Supervisor(*args, **kw)
+
+    sup = mk()
+    emitted = collections.Counter()
+    dups_allowed = False
+    replans = failures = crashes = 0
+    i = guard = 0
+    while i < len(batches):
+        guard += 1
+        assert guard < 400, "replan-chaos schedule failed to make progress"
+        for site, p, times in REPLAN_FAULTS:
+            if rng.random() < p:
+                fp.FAILPOINTS.arm(site, times=times)
+        crash_after = rng.random() < 0.10
+        try:
+            for k, seq in sup.process(batches[i]):
+                emitted[canon_match(k, seq)] += 1
+            i += 1
+        except fp.InjectedFault:
+            crash_after = True
+        finally:
+            fp.FAILPOINTS.clear()
+        if crash_after:
+            crashes += 1
+            if sup._journal_suspended:
+                dups_allowed = True
+            replans += sup.replans
+            failures += sup.replan_failures
+            del sup
+            sup = mk(resume=True)
+            i = 0  # at-least-once source: re-submit all; dedup absorbs
+    replans += sup.replans
+    failures += sup.replan_failures
+    return sup, emitted, dups_allowed, replans, failures, crashes
+
+
+def assert_replan_chaos_invariants(seed, tmp_path, require_replan=False):
+    batches = gen_drift_batches(seed)
+    want_state, want_matches = oracle_run_pattern(
+        adapt_pattern(), batches, ADAPT_CFG
+    )
+    sup, emitted, dups_allowed, replans, failures, crashes = (
+        run_replan_chaos(seed, tmp_path)
+    )
+    if require_replan:
+        assert replans + failures >= 1, (
+            f"seed {seed}: the drift never exercised the replan path"
+        )
+    assert_states_equal(
+        sup.processor.state, want_state,
+        f"seed {seed} (replans={replans}, failed={failures}, "
+        f"crashes={crashes})",
+    )
+    if dups_allowed:
+        assert set(emitted) == set(want_matches), (
+            f"seed {seed}: match SET diverged in a dup-allowed run"
+        )
+    else:
+        assert emitted == want_matches, (
+            f"seed {seed}: exactly-once violated across replans "
+            f"(replans={replans}, failed={failures}, crashes={crashes})"
+        )
+    assert not any(sup.processor.counters().values())
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_replan_under_chaos(seed, tmp_path):
+    assert_replan_chaos_invariants(seed, tmp_path, require_replan=True)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(500, 540))
+def test_replan_under_chaos_sweep(seed, tmp_path):
+    assert_replan_chaos_invariants(seed, tmp_path)

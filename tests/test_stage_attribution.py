@@ -307,3 +307,81 @@ def test_per_key_heavy_hitters():
     assert pk["top"][0]["share"] >= max(e["share"] for e in pk["top"][1:])
     snap = proc.metrics_snapshot()
     assert snap["per_key"]["top"][0]["key"] == "hot"
+
+
+# ---------------------------------------------------------------------------
+# Measured per-conjunct selectivity (ISSUE 16 satellite): under
+# stage_attribution every consuming-edge conjunct is tallied marginally
+# (unconditioned, order-independent) on device and surfaces through
+# stage_counters / metrics_snapshot per_stage.
+# ---------------------------------------------------------------------------
+
+
+def _pricey(k, v, ts, st):
+    return v["price"] * 7 % 5 != 2
+
+
+def _cheap(k, v, ts, st):
+    return v["price"] > 110
+
+
+def _conjunct_stock_pattern():
+    from kafkastreams_cep_tpu import Query
+    from kafkastreams_cep_tpu.pattern.predicate import and_, hint
+
+    return (
+        Query()
+        .select("rise")
+        .where(and_(hint(_pricey, cost=50.0), hint(_cheap, cost=1.0)))
+        .then()
+        .select("dip").skip_till_next_match()
+        .where(lambda k, v, ts, st: v["price"] < 100)
+        .build()
+    )
+
+
+def test_measured_conjunct_tally_is_exact_and_in_snapshot():
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    pat = _conjunct_stock_pattern()
+    K, T = 4, 24
+    m = BatchMatcher(pat, K, ATTR_CFG)
+    st = m.init_state()
+    prices = []
+    for seed in (1, 2):
+        ev = stock_events(K, T, seed)
+        prices.append(np.asarray(ev.value["price"]))
+        st, _ = m.scan(st, ev)
+    allp = np.concatenate(prices, axis=None).astype(np.int64)
+    report = m.stage_counters(st)
+    cj = report["rise"]["conjuncts"]
+    assert len(cj) == 2 and len(report["dip"]["conjuncts"]) == 1
+    by = {
+        ("pricey" if "_pricey" in key else "cheap"): row
+        for key, row in cj.items()
+    }
+    # Row 0 of the tally: every conjunct is offered every valid event —
+    # the marginal (order-independent) denominator, identical per slot.
+    assert all(row["evals"] == allp.size for row in by.values())
+    assert by["cheap"]["accepts"] == int((allp > 110).sum())
+    assert by["pricey"]["accepts"] == int((allp * 7 % 5 != 2).sum())
+    for row in by.values():
+        assert row["selectivity"] == pytest.approx(
+            row["accepts"] / row["evals"]
+        )
+
+    # The processor snapshot carries the same rows under per_stage.
+    from kafkastreams_cep_tpu.runtime import CEPProcessor, Record
+
+    proc = CEPProcessor(pat, 4, ATTR_CFG, epoch=0)
+    proc.process(
+        [
+            Record(int(i % 4), {"price": int(p), "volume": 800}, i)
+            for i, p in enumerate(
+                np.linspace(90, 130, 40).astype(int)
+            )
+        ]
+    )
+    snap = proc.metrics_snapshot()
+    rows = snap["per_stage"]["rise"]["conjuncts"]
+    assert set(rows) == set(cj)
+    assert all(row["evals"] == 40 for row in rows.values())

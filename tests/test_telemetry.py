@@ -251,6 +251,9 @@ TIMING_KEYS = (
     "device_seconds", "decode_seconds", "pack_seconds", "dispatch_seconds",
     "gc_seconds", "events_per_second_device", "event_time_lag_ms", "hbm",
     "phases",
+    # Process-global LRU warmth: the second identical run hits programs
+    # the first one traced, so hits/misses are order-dependent by design.
+    "trace_cache",
 )
 
 

@@ -385,11 +385,14 @@ def test_walk_kernel_tiered_parity():
 
 @pytest.mark.slow
 def test_scan_kernel_untiered_vs_tiered_parity():
-    """Under CEP_SCAN_KERNEL the untiered side runs the whole-scan Pallas
-    program while the tiered side falls back to the per-step path — the
-    outputs must still be bit-identical.
+    """Under CEP_SCAN_KERNEL *both* sides run whole-scan Pallas programs:
+    the untiered engine's, and the native tiered program — the stencil
+    promotion feed joins the event stream, the promotion phase fuses
+    after the engine phases, and every step is gated on device
+    (``build_scan(..., promotion=p)``).  Matches, emission order, and
+    loss counters must still be bit-identical.
 
-    Slow-tier: the interpret-mode whole-scan program alone costs ~45 s on
+    Slow-tier: the interpret-mode whole-scan programs cost ~1 min each on
     CPU CI; the jnp and walk-kernel differential corpus above stays
     tier-1 (and the untiered scan kernel is itself pinned bit-identical
     to the per-step path by tests/test_scan_kernel.py, so tier-1 already
@@ -403,6 +406,7 @@ def test_scan_kernel_untiered_vs_tiered_parity():
         b = BatchMatcher(pat, K, KCFG)
         assert b.uses_scan_kernel
         tm = TieredBatchMatcher(pat, K, KCFG)
+        assert tm.uses_scan_kernel  # the native tiered program, no fallback
         sb, ob = b.scan(b.init_state(), ev)
         st, ot = tm.scan(tm.init_state(), ev)
     finally:
@@ -410,6 +414,41 @@ def test_scan_kernel_untiered_vs_tiered_parity():
     g = grid(ob)
     assert g and g == grid(ot)
     assert b.counters(sb) == tm.counters(st)
+    assert tm.tier_counters(st)["tier_promotions"] > 0
+    # Whole-batch kernel dispatches are host-counted; no chunk gating ran.
+    assert tm.nfa_dispatches == 1 and tm.gate_chunks == 0
+
+
+@pytest.mark.slow
+def test_scan_kernel_tiered_vs_chunked_parity():
+    """The native tiered whole-scan program vs the chunk-gated per-step
+    hybrid path: identical matches, loss counters, and promotion counts
+    across a multi-batch scan (the kernel's per-step gate and fused
+    promotion phase replay the chunked schedule's observable behaviour
+    bit-exactly; dead slab entries may hold different inert pointer
+    garbage between the two slab representations, so raw state equality
+    is deliberately not asserted)."""
+    K, T = 128, 8
+    pat = prefix_n_minus_1()
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    os.environ["CEP_SCAN_KERNEL"] = "interpret"
+    try:
+        tk = TieredBatchMatcher(pat, K, KCFG)
+        assert tk.uses_scan_kernel
+    finally:
+        del os.environ["CEP_SCAN_KERNEL"]
+    tc = TieredBatchMatcher(pat, K, KCFG)
+    assert not tc.uses_scan_kernel
+    sk, sc_ = tk.init_state(), tc.init_state()
+    for seed in (9, 10):
+        ev = _kernel_trace(K, T, seed)
+        sk, ok = tk.scan(sk, ev)
+        sc_, oc = tc.scan(sc_, ev)
+        g = grid(ok)
+        assert g and g == grid(oc)
+    assert tk.counters(sk) == tc.counters(sc_)
+    assert tk.tier_counters(sk) == tc.tier_counters(sc_)
+    assert tk.tier_counters(sk)["tier_promotions"] > 0
 
 
 # ---------------------------------------------------------------------------
@@ -549,3 +588,138 @@ def test_untiered_snapshots_carry_zero_tier_counters():
     snap = b.metrics_snapshot(s)
     for n in TIER_COUNTER_NAMES:
         assert snap[n] == 0
+
+
+# ---------------------------------------------------------------------------
+# Chunk-gated hybrid dispatch (ISSUE 16): the skip/run decision is a
+# device-side lax.cond per gate_chunk-sized slice — no host round-trip.
+# ---------------------------------------------------------------------------
+
+
+def test_hybrid_gated_scan_never_syncs_host(monkeypatch):
+    """Acceptance: zero per-scan host syncs in hybrid gating.  The chunk
+    gate decides skip-vs-dispatch on device, so ``scan`` must never call
+    ``jax.device_get`` — the engine's only host-sync primitive — and
+    pipelined callers keep full dispatch/decode overlap.  Telemetry
+    reads do sync, but only when asked, off the scan path."""
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    monkeypatch.delenv("CEP_SCAN_KERNEL", raising=False)
+    K = 4
+    tm = TieredBatchMatcher(sc.skip_till_any(), K, TCFG)
+    assert tm.plan.tier == TIER_HYBRID and not tm.uses_scan_kernel
+    codes, rng = random_codes(K, 48, seed=7)
+    batches = list(ragged_batches(codes, rng, 16))
+    st = tm.init_state()
+    st, _ = tm.scan(st, batches[0])  # compile outside the counted window
+    syncs = []
+    real = jax.device_get
+
+    def counting_get(x):
+        syncs.append(type(x).__name__)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    for ev in batches[1:]:
+        st, out = tm.scan(st, ev)
+    # Force all queued device work to finish while the counter is armed:
+    # any hidden sync inside scan would already have fired above.
+    jax.block_until_ready(jax.tree_util.tree_leaves(st))
+    assert syncs == [], syncs
+    assert tm.gate_chunks == len(batches) * -(
+        -16 // int(TCFG.gate_chunk)
+    )
+    # Reading the dispatch tally is where the (single) sync lives.
+    n = tm.nfa_dispatches
+    assert syncs, "nfa_dispatches must be the device read"
+    assert 0 <= n <= tm.gate_chunks
+
+
+def test_gate_chunk_size_is_pure_scheduling():
+    """gate_chunk only changes how dispatch is amortised: every chunk
+    size yields the untiered engine's exact matches and counters; only
+    the gate telemetry differs (ceil(T/C) offered chunks per scan)."""
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    os.environ.pop("CEP_SCAN_KERNEL", None)
+    K = 6
+    pat = sc.skip_till_any()
+    # total=24 + per-batch sweeps keep the branchy skip-till-any trace
+    # drop-free on the shared config (same sizing as the corpus test).
+    codes, rng = random_codes(K, 24, seed=23)
+    batches = list(ragged_batches(codes, rng, 16))
+    ref = BatchMatcher(pat, K, CFG)
+    sr = ref.init_state()
+    want = []
+    for ev in batches:
+        sr, o = ref.scan(sr, ev)
+        want.append(grid(o))
+        sr = ref.sweep(sr)
+    assert any(want), "trace must produce matches"
+    assert all(ref.counters(sr)[n] == 0 for n in DROP_COUNTERS)
+    # One per regime: per-event gating, mid-size (uneven 16/3 tail
+    # chunk), and chunk > batch (whole-scan gate).  Each size is a
+    # distinct compiled program, so the sweep is priced per entry.
+    for chunk in (1, 3, 64):
+        tm = TieredBatchMatcher(
+            pat, K, dataclasses.replace(TCFG, gate_chunk=chunk)
+        )
+        st = tm.init_state()
+        for ev, g in zip(batches, want):
+            st, o = tm.scan(st, ev)
+            assert grid(o) == g, chunk
+            st = tm.sweep(st)
+        assert tm.counters(st) == ref.counters(sr), chunk
+        assert tm.gate_chunks == len(batches) * -(-16 // chunk)
+        assert 0 <= tm.nfa_dispatches <= tm.gate_chunks
+
+
+def test_pipelined_tiered_dispatch_never_blocks(monkeypatch):
+    """Timing guard for the pipelined-overlap fix (PROFILE_r09 §4): with
+    the per-scan host gate gone, a pipelined tiered processor's dispatch
+    and device phases perform no host sync at all — batch N's scan stays
+    in flight while decode pulls batch N-1's outputs.  Phase-tagging the
+    sync primitives pins every pull to the decode/gc phases."""
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    monkeypatch.delenv("CEP_SCAN_KERNEL", raising=False)
+    K = 4
+    proc = CEPProcessor(
+        sc.skip_till_next(), K, TCFG, epoch=0, pipeline=True
+    )
+    assert proc.batch.plan.tier == TIER_HYBRID
+    codes, _ = random_codes(K, 60, seed=3)
+    _feed(proc, codes, 0, 10)  # compile outside the guarded window
+    current = {"phase": None}
+    orig_phase = proc._phase
+
+    class _Tag:
+        def __init__(self, name):
+            self.name, self.cm = name, orig_phase(name)
+
+        def __enter__(self):
+            current["phase"] = self.name
+            return self.cm.__enter__()
+
+        def __exit__(self, *exc):
+            current["phase"] = None
+            return self.cm.__exit__(*exc)
+
+    monkeypatch.setattr(proc, "_phase", _Tag)
+    syncs = []
+    real_get, real_block = jax.device_get, jax.block_until_ready
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (syncs.append(("get", current["phase"])), real_get(x))[1],
+    )
+    monkeypatch.setattr(
+        jax, "block_until_ready",
+        lambda x: (
+            syncs.append(("block", current["phase"])), real_block(x)
+        )[1],
+    )
+    matches = _feed(proc, codes, 10, 60)
+    blocked = [s for s in syncs if s[1] in ("dispatch", "device", "drain")]
+    assert blocked == [], blocked
+    # Non-vacuous: real matches decoded inside the guarded window, so
+    # the decode pull (batch N-1's outputs, overlapping batch N's
+    # in-flight scan — a scalar int(c_n) plus the compacted rows) ran
+    # without ever blocking the dispatch side.
+    assert len(matches) > 0

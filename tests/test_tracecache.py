@@ -1,0 +1,124 @@
+"""Process-level trace cache (ISSUE 16 satellite).
+
+The contract (utils/tracecache.py): builders register jitted programs
+under structural keys and equal keys share the cached callable verbatim;
+LRU eviction bounds residency at ``CEP_TRACE_CACHE`` entries; ``0``/
+``off`` disables the cache entirely; and the hit/miss/eviction stats
+surface in ``CEPProcessor.metrics_snapshot`` so recompilation thrash —
+the failure mode adaptive replanning could otherwise induce — is
+observable from the same place as every other engine counter.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu.engine import EngineConfig
+from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
+from kafkastreams_cep_tpu.utils import tracecache
+
+CFG = EngineConfig(
+    max_runs=8, slab_entries=16, slab_preds=4, dewey_depth=8, max_walk=8,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(monkeypatch):
+    """Each test sees an empty cache at default capacity, and leaves an
+    empty cache behind (other test files only lose warm entries)."""
+    monkeypatch.delenv("CEP_TRACE_CACHE", raising=False)
+    tracecache.clear()
+    yield
+    tracecache.clear()
+
+
+def test_lookup_caches_by_namespaced_key():
+    built = []
+
+    def build():
+        built.append(1)
+        return object()
+
+    a = tracecache.lookup("ns", "k", build)
+    b = tracecache.lookup("ns", "k", build)
+    assert a is b and len(built) == 1
+    # A different namespace is a different slot for the same key.
+    c = tracecache.lookup("other", "k", build)
+    assert c is not a and len(built) == 2
+    s = tracecache.stats()
+    assert s["hits"] == 1 and s["misses"] == 2 and s["entries"] == 2
+    assert s["capacity"] == tracecache._DEFAULT_CAPACITY
+
+
+def test_unkeyable_and_disabled_bypass(monkeypatch):
+    built = []
+
+    def build():
+        built.append(1)
+        return len(built)
+
+    # key=None (tables_key refused the pattern): always rebuilds.
+    assert tracecache.lookup("ns", None, build) == 1
+    assert tracecache.lookup("ns", None, build) == 2
+    monkeypatch.setenv("CEP_TRACE_CACHE", "0")
+    assert tracecache.capacity() == 0
+    assert tracecache.lookup("ns", "k", build) == 3
+    assert tracecache.lookup("ns", "k", build) == 4
+    assert tracecache.stats()["entries"] == 0
+
+
+def test_lru_eviction_order(monkeypatch):
+    monkeypatch.setenv("CEP_TRACE_CACHE", "2")
+    built = []
+
+    def build(k):
+        def f():
+            built.append(k)
+            return ("prog", k)
+
+        return f
+
+    tracecache.lookup("ns", "a", build("a"))
+    tracecache.lookup("ns", "b", build("b"))
+    tracecache.lookup("ns", "a", build("a"))  # hit: a becomes MRU
+    tracecache.lookup("ns", "c", build("c"))  # evicts b, the LRU
+    tracecache.lookup("ns", "a", build("a"))  # still resident
+    tracecache.lookup("ns", "b", build("b"))  # rebuilt after eviction
+    assert built == ["a", "b", "c", "b"]
+    s = tracecache.stats()
+    assert s["entries"] == 2 and s["capacity"] == 2
+    assert s["evictions"] == 2  # b once, then c
+    assert s["hits"] == 2 and s["misses"] == 4
+
+
+def test_matcher_rebuilds_hit_the_cache():
+    """Rebuilding a matcher for an already-compiled (pattern, config) —
+    the evacuation/recovery/replan path — reuses the cached programs
+    instead of re-tracing."""
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    pat = sc.strict3()
+    BatchMatcher(pat, 4, CFG)
+    mid = tracecache.stats()
+    assert mid["misses"] > 0 and mid["entries"] > 0
+    BatchMatcher(pat, 4, CFG)
+    after = tracecache.stats()
+    assert after["hits"] > mid["hits"]
+    assert after["entries"] == mid["entries"]
+
+
+def test_processor_snapshot_surfaces_cache_stats():
+    from kafkastreams_cep_tpu.runtime import CEPProcessor, Record
+
+    os.environ["CEP_WALK_KERNEL"] = "0"
+    proc = CEPProcessor(sc.strict3(), 4, CFG, epoch=0)
+    proc.process([Record(0, int(v), t) for t, v in enumerate((0, 1, 2))])
+    snap = proc.metrics_snapshot()
+    tc = snap["trace_cache"]
+    assert set(tc) == {
+        "entries", "hits", "misses", "evictions", "capacity",
+    }
+    assert tc["entries"] >= 1 and tc["misses"] >= 1
+    assert np.isfinite(tc["capacity"])
+    assert tc["capacity"] == tracecache._DEFAULT_CAPACITY
