@@ -47,7 +47,11 @@ the naive-fused stacked bank, default 1;
 ``CEP_BENCH_ADAPT`` (adaptive recompilation: hybrid sweep under the
 chunk-gated scan + drift A/B with/without ``AdaptPolicy`` replanning,
 default 1; ``CEP_BENCH_ADAPT_{K,T,CHUNK,REPS,DRIFT_B}`` size it),
-``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
+``CEP_BENCH_TENANT_ISO`` (per-tenant isolation: compliant-tenant
+throughput with one quota-limited flooding tenant, shed accounting, and
+quarantine-entry latency, default 1;
+``CEP_BENCH_TENANT_ISO_{K,B,BATCHES}`` size it), ``CEP_PLATFORM``
+(force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -2041,6 +2045,150 @@ def bench_shard_fault():
     return out
 
 
+def bench_tenant_iso():
+    """``CEP_BENCH_TENANT_ISO``: per-tenant isolation probes (ISSUE 17).
+
+    One tenant floods the bank — a promote-every-pair prefix whose
+    suffix never closes, the run-queue-exhausting worst case — while
+    compliant tenants run a normal workload with the flooder's quota
+    enforced (``match_rate_budget=0``: every one of its prefix fires is
+    shed at the shared screen).
+
+    * ``clean_evps`` / ``flooded_evps`` — compliant-workload record
+      throughput without and with the quota-limited flooding tenant;
+    * ``shed_fires`` — the flooder's screen sheds (must be > 0 or the
+      scenario was vacuous);
+    * ``quarantine_s`` — quarantine-entry latency: the enforcement
+      rebuild (column gating + fresh screen jit) plus the first batch
+      dispatched with the tenant dark;
+    * ``parity`` — compliant tenants' matches bit-equal to a bank that
+      never contained the flooder (the blast-radius contract,
+      guarded by bench_gate once recorded);
+    * ``compliant_lossfree`` — compliant tenants shed nothing: zero
+      ``quota_shed`` and zero capacity-loss counters.
+    """
+    from kafkastreams_cep_tpu import Query
+    from kafkastreams_cep_tpu.compiler.multitenant import TenantQuota
+    from kafkastreams_cep_tpu.runtime import Record
+    from kafkastreams_cep_tpu.runtime.tenant import TenantCEP
+
+    K = int(os.environ.get("CEP_BENCH_TENANT_ISO_K", "64"))
+    n_batches = int(os.environ.get("CEP_BENCH_TENANT_ISO_BATCHES", "6"))
+    batch_records = int(os.environ.get("CEP_BENCH_TENANT_ISO_B", "2048"))
+    # Sized so the COMPLIANT workload is loss-free (the lossfree flag is
+    # about isolation, not capacity): the flooder never reaches the
+    # engine — its pressure lands on the shared screen and is shed there.
+    cfg = EngineConfig(
+        max_runs=16, slab_entries=64, slab_preds=8, dewey_depth=128,
+        max_walk=8,
+    )
+
+    def _ge(th):
+        return lambda k, v, ts, st, th=th: v["x"] >= th
+
+    def _lt(th):
+        return lambda k, v, ts, st, th=th: v["x"] < th
+
+    def q3(a, b, c):
+        return (
+            Query()
+            .select("a").where(_ge(a)).then()
+            .select("b").where(_lt(b)).then()
+            .select("c").where(_ge(c)).build()
+        )
+
+    def qh(a, b, z):
+        return (
+            Query()
+            .select("a").where(_ge(a)).then()
+            .select("b").where(_lt(b)).then()
+            .select("z").skip_till_next_match().where(_ge(z)).build()
+        )
+
+    def compliant_patterns():
+        return {"spike": q3(8, 3, 7), "dip": qh(8, 3, 9)}
+
+    def flooded_patterns():
+        out = compliant_patterns()
+        out["flood"] = qh(0, 10, 99)  # fires every pair, never closes
+        return out
+
+    rng = np.random.default_rng(17)
+    per_lane = max(batch_records // K, 2)
+    ts = 0
+    bs = []
+    for _ in range(n_batches + 1):  # +1: the quarantine-entry batch
+        recs = []
+        for i in range(per_lane * K):
+            ts += 1
+            recs.append(
+                Record(i % K, {"x": int(rng.integers(0, 10))}, ts)
+            )
+        bs.append(recs)
+
+    def canon(matches):
+        return [
+            (qn, k, tuple(sorted(
+                (st, e.partition, e.offset)
+                for st, evs in seq.as_map().items()
+                for e in evs
+            )))
+            for qn, k, seq in matches
+        ]
+
+    out = {}
+    clean = TenantCEP(compliant_patterns(), K, cfg)
+    clean.process(bs[0])  # warm the compile before timing
+    t0 = time.perf_counter()  # host-timed (compliant-only throughput)
+    clean_m = [canon(clean.process(b)) for b in bs[1:n_batches]]
+    dt = time.perf_counter() - t0
+    out["clean_evps"] = round(per_lane * K * (n_batches - 1) / dt, 1)
+
+    flooded = TenantCEP(
+        flooded_patterns(), K, cfg,
+        quotas={"flood": TenantQuota(match_rate_budget=0.0)},
+    )
+    flooded.process(bs[0])
+    t0 = time.perf_counter()  # host-timed (1 flooding tenant, quotaed)
+    fl_m = [canon(flooded.process(b)) for b in bs[1:n_batches]]
+    dt = time.perf_counter() - t0
+    out["flooded_evps"] = round(per_lane * K * (n_batches - 1) / dt, 1)
+
+    pq = flooded.per_query_counters()
+    out["shed_fires"] = pq["flood"]["quota_shed"]
+
+    t0 = time.perf_counter()  # host-timed (rebuild + first dark batch)
+    flooded.quarantine("flood", "bench")
+    q_m = canon(flooded.process(bs[n_batches]))
+    out["quarantine_s"] = round(time.perf_counter() - t0, 3)
+    clean_q = canon(clean.process(bs[n_batches]))
+
+    compliant = lambda ms: [m for m in ms if m[0] != "flood"]
+    out["parity"] = bool(
+        [compliant(m) for m in fl_m] == clean_m
+        and compliant(q_m) == clean_q
+    )
+    out["compliant_lossfree"] = bool(
+        out["shed_fires"] > 0
+        and all(
+            pq[n]["quota_shed"] == 0
+            and all(pq[n][c] == 0 for c in (
+                "run_drops", "ver_overflows", "slab_full_drops",
+                "slab_pred_drops", "slab_trunc", "handle_overflows",
+            ))
+            for n in ("spike", "dip")
+        )
+    )
+    log(
+        f"tenant-iso (K={K}, {per_lane * K}-record batches): compliant "
+        f"{out['clean_evps']} ev/s clean vs {out['flooded_evps']} ev/s "
+        f"with a quota-limited flooder ({out['shed_fires']} fires shed), "
+        f"quarantine entry {out['quarantine_s']}s, parity="
+        f"{out['parity']}, compliant_lossfree={out['compliant_lossfree']}"
+    )
+    return out
+
+
 def bench_ooo():
     """``CEP_BENCH_OOO``: graceful-ingestion A/B (ISSUE 5).
 
@@ -2218,6 +2366,16 @@ def main():
         shard = bench_shard_fault()
         return {"shard": shard} if shard else {}
 
+    def _tenant_iso_block():
+        # Nested under ``resilience`` like the shard-fault probes:
+        # absent entirely when skipped, which bench_gate treats as a
+        # missing metric, not a regression.
+        if os.environ.get("CEP_BENCH_TENANT_ISO", "1") != "1":
+            log("tenant-iso: skipped (CEP_BENCH_TENANT_ISO=0)")
+            return {}
+        block = bench_tenant_iso()
+        return {"tenant": block} if block else {}
+
     if os.environ.get("CEP_BENCH_EXTRAS", "1") != "0":
         budget = float(os.environ.get("CEP_BENCH_BUDGET_S", "1200"))
         extras = [
@@ -2260,6 +2418,10 @@ def main():
             (
                 "shard-fault",
                 lambda: resilience.update(_shard_fault_block()),
+            ),
+            (
+                "tenant-iso",
+                lambda: resilience.update(_tenant_iso_block()),
             ),
             (
                 "processor",

@@ -61,6 +61,8 @@ FLAG_METRICS = (
     "tenant_loss_flags",
     "adapt_match_parity",
     "adapt_loss_flags",
+    "tenant_iso_parity",
+    "tenant_iso_compliant_lossfree",
 )
 #: Ratio metrics guarded like rates (0..1, higher is better).
 RATIO_METRICS = ("recall_sampled",)
@@ -99,6 +101,18 @@ def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         # and its all-counters-zero flag may never regress true -> false.
         flat["tenant_match_parity"] = tenants.get("match_parity")
         flat["tenant_loss_flags"] = tenants.get("counters_zero")
+    tenant_iso = (
+        resilience.get("tenant") if isinstance(resilience, dict) else None
+    )
+    if isinstance(tenant_iso, dict):
+        # Nested resilience.tenant block (BENCH_r09+) -> flat
+        # ``tenant_iso_*`` keys: with one tenant flooding, the compliant
+        # tenants' matches stay bit-equal to the unquotaed fault-free
+        # bank's (parity) and lose nothing (shed accounting reconciles).
+        flat["tenant_iso_parity"] = tenant_iso.get("parity")
+        flat["tenant_iso_compliant_lossfree"] = tenant_iso.get(
+            "compliant_lossfree"
+        )
     adapt = parsed.get("adapt")
     if isinstance(adapt, dict):
         # Nested adapt block (BENCH_r08+) -> flat ``adapt_*`` keys: the

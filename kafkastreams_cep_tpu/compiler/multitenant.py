@@ -352,12 +352,76 @@ class PrefixColumn:
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Declared per-query resource shares, enforced at runtime by
+    ``parallel/tenantbank.py: TenantBankMatcher`` (the tenant-isolation
+    contract — README "Multi-tenant execution").
+
+    Every knob is optional (None = unlimited).  Enforcement is a
+    gather-level mask over the shared screen's prefix fires: an
+    over-quota tenant's completions are shed (counted per tenant in
+    ``quota_shed``) while compliant tenants' screen math is bit-identical
+    to an unquotaed bank.
+
+    ``max_live_lanes``    — lanes this query may hold live NFA runs on;
+                            measured from the stacked engine state each
+                            batch (enforced with a one-batch lag — the
+                            usage readback rides the existing gate
+                            transfer, costing no extra device sync).
+    ``handle_ring_share`` — fraction of the query's aggregate lazy-
+                            extraction handle-ring capacity
+                            (``K * EngineConfig.handle_ring``) it may
+                            hold pending; same one-batch lag.
+    ``match_rate_budget`` — token-bucket refill per batch on prefix
+                            fires; an empty bucket masks NEW prefix
+                            completions (runs already admitted finish).
+                            ``match_rate_burst`` caps the bucket
+                            (default ``2 * budget`` — a budget of 0
+                            sheds from the very first batch).
+    ``pred_eval_budget``  — per-batch bound on this query's screen work,
+                            counted on offered slots (``K * T *
+                            prefix_len`` — deterministic, known before
+                            dispatch); an over-budget batch has the
+                            query's fires masked for that batch.
+    """
+
+    max_live_lanes: Optional[int] = None
+    handle_ring_share: Optional[float] = None
+    match_rate_budget: Optional[float] = None
+    match_rate_burst: Optional[float] = None
+    pred_eval_budget: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_live_lanes is not None and self.max_live_lanes < 0:
+            raise ValueError("max_live_lanes must be >= 0")
+        if self.handle_ring_share is not None and not (
+            0.0 < self.handle_ring_share <= 1.0
+        ):
+            raise ValueError("handle_ring_share must be in (0, 1]")
+        if self.match_rate_budget is not None and self.match_rate_budget < 0:
+            raise ValueError("match_rate_budget must be >= 0")
+        if self.match_rate_burst is not None and self.match_rate_burst < 0:
+            raise ValueError("match_rate_burst must be >= 0")
+        if self.pred_eval_budget is not None and self.pred_eval_budget < 0:
+            raise ValueError("pred_eval_budget must be >= 0")
+
+    @property
+    def burst(self) -> float:
+        """Token-bucket cap for ``match_rate_budget`` (explicit
+        ``match_rate_burst``, else ``2 * budget``)."""
+        if self.match_rate_burst is not None:
+            return float(self.match_rate_burst)
+        return 2.0 * float(self.match_rate_budget or 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
 class QueryPlan:
     """One query's routing inside the bank."""
 
     tables: TransitionTables  # post lazy-order
     plan: TieringPlan
     prefix_cols: Tuple[int, ...]  # column ids, one per prefix stage
+    quota: Optional[TenantQuota] = None  # declared isolation contract
 
 
 @dataclasses.dataclass
@@ -382,6 +446,7 @@ def plan_bank(
     config=None,
     profile: Optional[Dict] = None,
     reorder: bool = True,
+    quotas: Optional[Sequence[Optional[TenantQuota]]] = None,
 ) -> BankPlan:
     """Compile N query plans into one bank plan.
 
@@ -397,6 +462,15 @@ def plan_bank(
     tlist = [
         p if isinstance(p, TransitionTables) else lower(p) for p in patterns
     ]
+    if quotas is None:
+        qlist: List[Optional[TenantQuota]] = [None] * len(tlist)
+    else:
+        qlist = list(quotas)
+        if len(qlist) != len(tlist):
+            raise ValueError(
+                f"quotas must have one entry per pattern: got {len(qlist)} "
+                f"for {len(tlist)} patterns"
+            )
     queries: List[QueryPlan] = []
     columns: List[PrefixColumn] = []
     interned: Dict[Hashable, int] = {}
@@ -431,7 +505,7 @@ def plan_bank(
             trie[node] = trie.get(node, 0) + 1
         if plan.tier != TIER_NFA:
             groups.setdefault(sig, []).append(q)
-        queries.append(QueryPlan(t, plan, sig))
+        queries.append(QueryPlan(t, plan, sig, quota=qlist[q]))
     pred_plan = plan_step_predicates([qp.tables for qp in queries])
     tiers = [qp.plan.tier for qp in queries]
     stats = {
@@ -444,6 +518,7 @@ def plan_bank(
         ),
         "prefix_groups": len(groups),
         "trie_nodes": len(trie),
+        "quotas_declared": sum(1 for q in qlist if q is not None),
         **{f"pred_{k}": v for k, v in pred_plan.stats.items()},
     }
     logger.info(

@@ -65,6 +65,7 @@ def owner_states(tables: TransitionTables) -> ArrayStates:
 def build_matrix(
     columns: Sequence[PrefixColumn],
     owner_tables: Sequence[TransitionTables],
+    disabled: Sequence[int] = (),
 ):
     """A fused evaluator ``matrix(ev) -> [K, T, C]`` for the bank's
     prefix column table.
@@ -74,7 +75,15 @@ def build_matrix(
     unobservable), private ones their owner's init env.  Values are
     ANDed with ``ev.valid`` so padded slots never fire — the same
     masking ``StencilPrefix._scan`` applies per stage.
+
+    ``disabled`` columns (tenant quarantine — ``parallel/tenantbank.py``
+    gates out every column used *only* by quarantined queries) are
+    emitted as constant ``False`` without calling the predicate at all:
+    a quarantined tenant's poisoned predicate can neither raise at trace
+    time nor consume screen work, and its users gather only ``False`` —
+    bit-identical to the screen of a bank that never contained them.
     """
+    dis = frozenset(int(c) for c in disabled)
     envs = [
         ArrayStates({}) if col.shared else owner_states(
             owner_tables[col.owner]
@@ -84,16 +93,21 @@ def build_matrix(
 
     def matrix(ev: EventBatch) -> jnp.ndarray:
         K, T = ev.valid.shape
+        dark = jnp.zeros((K, T), bool)
         return jnp.stack(
             [
-                jnp.broadcast_to(
-                    jnp.asarray(
-                        col.pred(ev.key, ev.value, ev.ts, env), bool
-                    ),
-                    (K, T),
+                dark
+                if ci in dis
+                else (
+                    jnp.broadcast_to(
+                        jnp.asarray(
+                            col.pred(ev.key, ev.value, ev.ts, env), bool
+                        ),
+                        (K, T),
+                    )
+                    & ev.valid
                 )
-                & ev.valid
-                for col, env in zip(columns, envs)
+                for ci, (col, env) in enumerate(zip(columns, envs))
             ],
             axis=-1,
         )

@@ -29,6 +29,26 @@ bit-identical to that query running alone on its own serial matcher
 verbatim under a query vmap, the promotions replay ``build_promote``
 with one-hot selected per-query constants, and group skip-gating only
 ever elides steps that change nothing but ``step_seq``.
+
+**Per-tenant isolation** (:class:`TenantIsolation`): each query may
+declare a :class:`~kafkastreams_cep_tpu.compiler.multitenant.TenantQuota`
+and the matcher *enforces* it — over-quota tenants get their prefix
+fires masked at the gather level inside the shared screen (a ``[Nq]``
+runtime mask, zero cost and bit-zero effect on compliant tenants) with
+sheds counted per tenant in ``quota_shed``.  Quarantine goes further:
+the query's exclusively-owned matrix columns are gated dark
+(``predmatrix.build_matrix(disabled=...)``), its lanes' events are
+invalidated inside its engine group (per-group ``active`` mask — lanes
+are qid-dispatched and independent, so co-members are untouched), and
+its frozen engine/carry state stays in the checkpoint for later
+:meth:`TenantBankMatcher.reinstate`.  Usage needed for the quota
+verdicts (live lanes, ring occupancy, fires/sheds) is computed
+device-side inside the screen and rides the SAME ``device_get`` as the
+hybrid gates — enforcement adds no device round-trip, only a one-batch
+verdict lag (documented in README "Isolation contract").  A bank with a
+tenant quarantined (or continuously shed) is bit-identical, for every
+other tenant, to a bank compiled without that tenant
+(tests/test_tenant_isolation.py differential proof).
 """
 
 from __future__ import annotations
@@ -42,6 +62,7 @@ import numpy as np
 
 from kafkastreams_cep_tpu.compiler.multitenant import (
     BankPlan,
+    TenantQuota,
     bank_key,
     plan_bank,
 )
@@ -83,6 +104,7 @@ from kafkastreams_cep_tpu.parallel.batch import (
 )
 from kafkastreams_cep_tpu.parallel.tiered import _bump_engine_jit
 from kafkastreams_cep_tpu.utils import tracecache
+from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
 from kafkastreams_cep_tpu.utils.logging import get_logger
 
 logger = get_logger("parallel.tenantbank")
@@ -134,6 +156,158 @@ def _stack_sig(t) -> tuple:
     )
 
 
+class TenantIsolation:
+    """Host-side per-tenant enforcement state: token buckets, throttle
+    verdicts, quarantine flags, and the per-tenant ``quota_shed`` loss
+    ledger.
+
+    Pure deterministic host bookkeeping — the device sees only the
+    per-batch ``[Nq]`` enabled masks it produces and hands back the
+    usage bundle :meth:`observe` consumes.  :meth:`to_state` round-trips
+    through the tenant checkpoint header, so throttle/quarantine
+    verdicts and shed counters survive crash/restore and replay
+    bit-identically (exactly-once for compliant tenants).
+
+    Verdict timing: the fires/live-lanes/ring usage a verdict needs is
+    read back together with the hybrid gates, so throttling reacts with
+    a ONE-BATCH lag (the batch that first exceeds a quota completes; the
+    next is masked).  The ``pred_eval_budget`` knob is the exception —
+    its usage (``K * T * prefix_len``) is known before dispatch, so it
+    masks the offending batch itself.
+    """
+
+    def __init__(
+        self,
+        quotas: Sequence[Optional[TenantQuota]],
+        num_lanes: int,
+        config: EngineConfig,
+    ):
+        self.quotas: List[Optional[TenantQuota]] = list(quotas)
+        N = len(self.quotas)
+        self.K = int(num_lanes)
+        self.config = config
+        self.quota_shed = np.zeros(N, np.int64)
+        self.offered_fires = np.zeros(N, np.int64)
+        self.throttled = np.zeros(N, bool)
+        self.quarantined = np.zeros(N, bool)
+        self.over: List[Tuple[str, ...]] = [() for _ in range(N)]
+        self.live_lanes = np.zeros(N, np.int64)
+        self.ring_pending = np.zeros(N, np.int64)
+        self.tokens = np.full(N, np.inf)
+        self.throttle_transitions = 0
+        for q, quota in enumerate(self.quotas):
+            if quota is None or quota.match_rate_budget is None:
+                continue
+            self.tokens[q] = quota.burst
+            if quota.burst < 1.0:
+                # A zero/sub-1 budget sheds from the very first batch —
+                # the deterministic "continuously shed" configuration the
+                # differential blast-radius proof uses.
+                self.throttled[q] = True
+                self.over[q] = ("match_rate_budget",)
+
+    # -- per-batch verdicts --------------------------------------------------
+
+    def enabled(self, qids: Sequence[int], p: int, T: int) -> np.ndarray:
+        """The ``[Nq]`` fire mask for one prefix group this batch."""
+        m = np.ones(len(qids), bool)
+        for i, q in enumerate(qids):
+            if self.quarantined[q] or self.throttled[q]:
+                m[i] = False
+                continue
+            quota = self.quotas[q]
+            if (
+                quota is not None
+                and quota.pred_eval_budget is not None
+                and self.K * T * p > quota.pred_eval_budget
+            ):
+                m[i] = False
+        return m
+
+    def observe(
+        self,
+        fires: np.ndarray,
+        sheds: np.ndarray,
+        live: np.ndarray,
+        ring: np.ndarray,
+    ) -> None:
+        """Fold one batch's usage readback into the ledgers and
+        recompute every quotaed tenant's verdict for the next batch."""
+        fires = fires.astype(np.int64)
+        sheds = sheds.astype(np.int64)
+        self.offered_fires += fires + sheds
+        self.quota_shed += sheds
+        self.live_lanes = live.astype(np.int64)
+        self.ring_pending = ring.astype(np.int64)
+        for q, quota in enumerate(self.quotas):
+            if quota is None or self.quarantined[q]:
+                continue
+            over: List[str] = []
+            if quota.match_rate_budget is not None:
+                self.tokens[q] = min(
+                    quota.burst, self.tokens[q] + quota.match_rate_budget
+                ) - float(fires[q])
+                if self.tokens[q] < 1.0:
+                    over.append("match_rate_budget")
+            if (
+                quota.max_live_lanes is not None
+                and self.live_lanes[q] > quota.max_live_lanes
+            ):
+                over.append("max_live_lanes")
+            if (
+                quota.handle_ring_share is not None
+                and self.config.handle_ring > 0
+            ):
+                cap = (
+                    quota.handle_ring_share
+                    * self.K
+                    * self.config.handle_ring
+                )
+                if self.ring_pending[q] > cap:
+                    over.append("handle_ring_share")
+            was = bool(self.throttled[q])
+            self.throttled[q] = bool(over)
+            self.over[q] = tuple(over)
+            if was != self.throttled[q]:
+                self.throttle_transitions += 1
+                logger.warning(
+                    "tenant q%d %s (over: %s)",
+                    q,
+                    "throttled" if over else "unthrottled",
+                    over or "-",
+                )
+
+    # -- durability ----------------------------------------------------------
+
+    def to_state(self) -> Dict[str, object]:
+        return {
+            "quota_shed": self.quota_shed.copy(),
+            "offered_fires": self.offered_fires.copy(),
+            "throttled": self.throttled.copy(),
+            "quarantined": self.quarantined.copy(),
+            "tokens": self.tokens.copy(),
+            "live_lanes": self.live_lanes.copy(),
+            "ring_pending": self.ring_pending.copy(),
+            "over": [tuple(o) for o in self.over],
+            "throttle_transitions": self.throttle_transitions,
+        }
+
+    def load_state(self, state: Dict[str, object]) -> None:
+        self.quota_shed = np.asarray(state["quota_shed"], np.int64).copy()
+        self.offered_fires = np.asarray(
+            state["offered_fires"], np.int64
+        ).copy()
+        self.throttled = np.asarray(state["throttled"], bool).copy()
+        self.quarantined = np.asarray(state["quarantined"], bool).copy()
+        self.tokens = np.asarray(state["tokens"], np.float64).copy()
+        self.live_lanes = np.asarray(state["live_lanes"], np.int64).copy()
+        self.ring_pending = np.asarray(
+            state["ring_pending"], np.int64
+        ).copy()
+        self.over = [tuple(o) for o in state["over"]]
+        self.throttle_transitions = int(state["throttle_transitions"])
+
+
 def _build_group_programs(
     group: _EngineGroup, cfg: EngineConfig, K: int
 ):
@@ -144,6 +318,12 @@ def _build_group_programs(
     prefix group's ``[Np, K, T, ...]`` tensor (static member rows), and
     runs the step-then-promote schedule of the single-query tiered
     matcher per lane — qid-dispatched, so each lane is its own query.
+
+    Both scans take a runtime ``active [Qg]`` member mask (tenant
+    quarantine): an inactive member's lanes see their events invalidated
+    (and its promotion fires zeroed), freezing its runs in place without
+    retracing — lanes are qid-dispatched and independent, so active
+    members step bit-identically to an all-active group.
     """
     Qg = group.Q
     L = Qg * K
@@ -151,10 +331,12 @@ def _build_group_programs(
     qids = jnp.repeat(jnp.arange(Qg, dtype=jnp.int32), K)
     use_kernel, interpret = _select_walk_kernel(cfg, L)
 
-    def rep(events):
-        return jax.tree_util.tree_map(
+    def rep(events, active):
+        ev = jax.tree_util.tree_map(
             lambda x: jnp.concatenate([x] * Qg, axis=0), events
         )
+        lane_on = jnp.repeat(jnp.asarray(active, bool), K)[:, None]
+        return ev._replace(valid=ev.valid & lane_on)
 
     def unstack(out):
         return jax.tree_util.tree_map(
@@ -174,8 +356,8 @@ def _build_group_programs(
                     )
                 )(state, events, qids)
 
-        def scan(state: EngineState, events: EventBatch):
-            state, out = inner_scan(state, rep(events))
+        def scan(state: EngineState, events: EventBatch, active):
+            state, out = inner_scan(state, rep(events, active))
             return state, unstack(out)
 
         scan_jit = jax.jit(scan)
@@ -192,13 +374,15 @@ def _build_group_programs(
         )
         rows_ix = jnp.asarray(group.rows, jnp.int32)
 
-        def scan(eng: EngineState, events: EventBatch, promo_pg):
-            ev = rep(events)
+        def scan(eng: EngineState, events: EventBatch, promo_pg, active):
+            ev = rep(events, active)
             # [Np, K, T, ...] -> member rows -> flat [Qg*K, T, ...].
             pr = jax.tree_util.tree_map(
                 lambda x: x[rows_ix].reshape((L,) + x.shape[2:]),
                 promo_pg,
             )
+            lane_on = jnp.repeat(jnp.asarray(active, bool), K)[:, None]
+            pr = pr._replace(fire=pr.fire & lane_on)
             swap = lambda x: jnp.swapaxes(x, 0, 1)
             ev_t = jax.tree_util.tree_map(swap, ev)
             pr_t = jax.tree_util.tree_map(swap, pr)
@@ -235,7 +419,13 @@ class TenantBankMatcher:
     by shape internally and the whole bank shares one prefix screen.
 
     ``names`` optionally labels queries for the per-query telemetry
-    breakdown (defaults to ``q0..qN-1``).
+    breakdown (defaults to ``q0..qN-1``).  ``quotas`` optionally
+    declares the per-tenant isolation contract — a dict keyed by query
+    name (or a sequence aligned with ``patterns``) of
+    :class:`~kafkastreams_cep_tpu.compiler.multitenant.TenantQuota`;
+    declared quotas are attached to the bank plan and ENFORCED here
+    (fires masked, sheds counted in ``quota_shed`` — see
+    :class:`TenantIsolation`).
     """
 
     def __init__(
@@ -246,20 +436,40 @@ class TenantBankMatcher:
         profile: Optional[Dict] = None,
         reorder: bool = True,
         names: Optional[Sequence[str]] = None,
+        quotas=None,
     ):
         self.config = config or EngineConfig()
         self.K = int(lanes_per_query)
-        self.bank: BankPlan = plan_bank(
-            patterns, self.config, profile, reorder
-        )
-        self.N = len(self.bank.queries)
+        patterns = list(patterns)
         self.query_names = (
             list(names)
             if names is not None
-            else [f"q{q}" for q in range(self.N)]
+            else [f"q{q}" for q in range(len(patterns))]
         )
-        if len(self.query_names) != self.N:
+        if len(self.query_names) != len(patterns):
             raise ValueError("names must have one entry per pattern")
+        if quotas is None:
+            qlist: List[Optional[TenantQuota]] = [None] * len(patterns)
+        elif isinstance(quotas, dict):
+            unknown = set(quotas) - set(self.query_names)
+            if unknown:
+                raise ValueError(
+                    f"quotas for unknown queries: {sorted(unknown)}"
+                )
+            qlist = [quotas.get(n) for n in self.query_names]
+        else:
+            qlist = list(quotas)
+            if len(qlist) != len(patterns):
+                raise ValueError(
+                    "quotas must have one entry per pattern"
+                )
+        self.bank: BankPlan = plan_bank(
+            patterns, self.config, profile, reorder, quotas=qlist
+        )
+        self.N = len(self.bank.queries)
+        self.iso = TenantIsolation(
+            [qp.quota for qp in self.bank.queries], self.K, self.config
+        )
         self.scan_calls = 0
         self.nfa_dispatches = 0
 
@@ -343,6 +553,17 @@ class TenantBankMatcher:
             self.bank.stats["pred_dedup_ratio"],
         )
 
+        # Column -> referencing queries (quarantine gates a column dark
+        # only when EVERY user is quarantined; a column shared with a
+        # live tenant keeps evaluating — the live tenant paid for it).
+        self._col_users: Dict[int, set] = {}
+        for q, qp in enumerate(self.bank.queries):
+            for cid in qp.prefix_cols:
+                self._col_users.setdefault(int(cid), set()).add(q)
+        self._disabled_cols: frozenset = frozenset()
+        self._gactive: List[np.ndarray] = [
+            np.ones(g.Q, bool) for g in self._groups
+        ]
         self._screen_jit = self._cached_screen()
 
     # -- program construction (trace-cached) ---------------------------------
@@ -383,15 +604,36 @@ class TenantBankMatcher:
         if not self._pgroups:
             return None
         key = self._struct_key()
+        if key is not None:
+            # The disabled-column set is baked into the matrix closure
+            # (quarantined tenants' private columns are constant False),
+            # and K into the gate/usage reshapes, so both must join the
+            # structural key.
+            key = (key, tuple(sorted(self._disabled_cols)), self.K)
         return tracecache.lookup(
             "tenant.screen", key, lambda: jax.jit(self._build_screen())
         )
 
     def _build_screen(self):
         """The whole-bank screen: matrix -> per-p-group recurrence ->
-        stencil synthesis + hybrid gates, one fused program."""
+        fire-mask enforcement -> stencil synthesis + hybrid gates + the
+        usage bundle, one fused program.
+
+        ``masks[i]`` is prefix group ``i``'s ``[Nq]`` enabled mask (a
+        runtime arg — no retrace on a throttle flip); a masked member's
+        fires are zeroed before synthesis/promotion and counted in the
+        shed half of the usage bundle.  ``hactive`` masks a quarantined
+        member's frozen alive runs out of its group's gate so it cannot
+        force dispatches forever.  Everything the quota verdicts need
+        (fires, sheds, live lanes, ring occupancy) is computed here and
+        returned with the gates — ONE ``device_get`` per scan, exactly
+        as before.
+        """
         owner_tables = [qp.tables for qp in self.bank.queries]
-        matrix_fn = build_matrix(self.bank.columns, owner_tables)
+        matrix_fn = build_matrix(
+            self.bank.columns, owner_tables,
+            disabled=self._disabled_cols,
+        )
         scans = [bank_prefix_scan(pg.p) for pg in self._pgroups]
         synths = []
         for pg in self._pgroups:
@@ -411,18 +653,27 @@ class TenantBankMatcher:
             else:
                 synths.append(None)
         hybrids = [
-            (self._groups[i].pg,
+            (i, self._groups[i].pg,
              jnp.asarray(self._groups[i].rows, jnp.int32))
             for i in self._hybrid_idx
         ]
         sig_tables = [pg.sigs for pg in self._pgroups]
+        gQ = [g.Q for g in self._groups]
+        K = self.K
 
-        def screen(carries, alives, ev: EventBatch):
+        def screen(carries, galive, gring, ev: EventBatch, masks, hactive):
             mat = matrix_fn(ev)
             new_carries, promos, souts = [], [], []
+            fires_u, sheds_u = [], []
             for i, (scan, synth) in enumerate(zip(scans, synths)):
                 bools_q = group_bools(mat, sig_tables[i])
                 c2, promo = scan(carries[i], bools_q, ev)
+                m3 = masks[i][:, None, None]
+                sheds_u.append(
+                    jnp.sum(promo.fire & ~m3, axis=(1, 2))
+                )
+                promo = promo._replace(fire=promo.fire & m3)
+                fires_u.append(jnp.sum(promo.fire, axis=(1, 2)))
                 new_carries.append(c2)
                 promos.append(promo)
                 if synth is None:
@@ -439,15 +690,31 @@ class TenantBankMatcher:
             if hybrids:
                 gates = jnp.stack(
                     [
-                        jnp.any(alives[i])
+                        jnp.any(
+                            galive[gi]
+                            & jnp.repeat(hactive[h], K)[:, None]
+                        )
                         | jnp.any(promos[pgi].fire[rows])
-                        for i, (pgi, rows) in enumerate(hybrids)
+                        for h, (gi, pgi, rows) in enumerate(hybrids)
                     ]
                 )
             else:
                 gates = jnp.zeros((0,), bool)
+            live_u = tuple(
+                jnp.sum(
+                    jnp.any(a, axis=-1).reshape(q, K).astype(jnp.int32),
+                    axis=1,
+                )
+                for a, q in zip(galive, gQ)
+            )
+            ring_u = tuple(
+                jnp.sum(r.reshape(q, K), axis=1)
+                for r, q in zip(gring, gQ)
+            )
+            usage = (tuple(fires_u), tuple(sheds_u), live_u, ring_u)
             return (
                 tuple(new_carries), tuple(promos), tuple(souts), gates,
+                usage,
             )
 
         return screen
@@ -511,17 +778,28 @@ class TenantBankMatcher:
         jittable."""
         T = int(events.ts.shape[1])
         self.scan_calls += 1
+        masks_np = [
+            self.iso.enabled(pg.qids, pg.p, T) for pg in self._pgroups
+        ]
         if self._screen_jit is not None:
-            alives = tuple(
-                state.engine[i].alive for i in self._hybrid_idx
+            galive = tuple(e.alive for e in state.engine)
+            gring = tuple(e.hr_count for e in state.engine)
+            masks = tuple(jnp.asarray(m) for m in masks_np)
+            hactive = tuple(
+                jnp.asarray(self._gactive[i]) for i in self._hybrid_idx
             )
-            carries, promos, souts, gates = self._screen_jit(
-                state.carry, alives, events
+            carries, promos, souts, gates, usage = self._screen_jit(
+                state.carry, galive, gring, events, masks, hactive
             )
             carries = list(carries)
-            gates_h = np.asarray(jax.device_get(gates))
+            # ONE transfer: the hybrid gates AND the quota usage bundle
+            # ride the same device_get (the zero-extra-sync contract).
+            gates_h, usage_h = jax.device_get((gates, usage))
+            gates_h = np.asarray(gates_h)
         else:
-            carries, promos, souts, gates_h = [], (), (), np.zeros(0)
+            carries, promos, souts, gates_h, usage_h = (
+                [], (), (), np.zeros(0), None
+            )
 
         blocks: List[Tuple[List[int], StepOutput]] = []
         for pg, so in zip(self._pgroups, souts):
@@ -531,10 +809,11 @@ class TenantBankMatcher:
         engines = list(state.engine)
         hseq = 0
         for i, g in enumerate(self._groups):
+            active = jnp.asarray(self._gactive[i])
             if g.kind == "nfa":
                 self.nfa_dispatches += 1
                 _, _, _, scan_jit, _ = g.programs
-                engines[i], out_g = scan_jit(engines[i], events)
+                engines[i], out_g = scan_jit(engines[i], events, active)
                 blocks.append((g.qids, out_g))
                 continue
             gate = bool(gates_h[hseq])
@@ -550,7 +829,7 @@ class TenantBankMatcher:
             self.nfa_dispatches += 1
             _, _, _, scan_jit, _ = g.programs
             engines[i], out_g, promoted = scan_jit(
-                engines[i], events, promos[g.pg]
+                engines[i], events, promos[g.pg], active
             )
             c = carries[g.pg]
             carries[g.pg] = c._replace(
@@ -560,11 +839,106 @@ class TenantBankMatcher:
             )
             blocks.append((g.qids, out_g))
 
+        self._observe_usage(usage_h)
         out = self._assemble(blocks)
         return (
             TenantState(engine=tuple(engines), carry=tuple(carries)),
             out,
         )
+
+    def _observe_usage(self, usage_h) -> None:
+        """Scatter the screen's per-group usage bundle back to global
+        query ids and let the isolation controller re-verdict."""
+        if usage_h is None:
+            return
+        fires_u, sheds_u, live_u, ring_u = usage_h
+        fires = np.zeros(self.N, np.int64)
+        sheds = np.zeros(self.N, np.int64)
+        live = np.zeros(self.N, np.int64)
+        ring = np.zeros(self.N, np.int64)
+        for pg, f, s in zip(self._pgroups, fires_u, sheds_u):
+            f = np.asarray(f)
+            s = np.asarray(s)
+            for r, q in enumerate(pg.qids):
+                fires[q] = f[r]
+                sheds[q] = s[r]
+        for g, lv, rg in zip(self._groups, live_u, ring_u):
+            lv = np.asarray(lv)
+            rg = np.asarray(rg)
+            for r, q in enumerate(g.qids):
+                live[q] = lv[r]
+                ring[q] = rg[r]
+        self.iso.observe(fires, sheds, live, ring)
+
+    # -- quarantine / reinstatement -------------------------------------------
+
+    @property
+    def quarantined_qids(self) -> List[int]:
+        return [int(q) for q in np.nonzero(self.iso.quarantined)[0]]
+
+    def quarantine(self, q: int) -> None:
+        """Circuit-break query ``q`` out of the bank: its exclusively
+        owned matrix columns go dark (the predicate is never called
+        again — a poisoned predicate cannot raise at trace time), its
+        lanes' events are invalidated in its engine group, and its fires
+        are masked.  Engine/carry state freezes in place (and stays in
+        checkpoints) for later :meth:`reinstate`.  The rest of the bank
+        is bit-identical to a bank compiled without ``q``."""
+        q = int(q)
+        if not 0 <= q < self.N:
+            raise ValueError(f"no query {q} in a bank of {self.N}")
+        if self.iso.quarantined[q]:
+            return
+        _failpoint("quarantine.enter")
+        self.iso.quarantined[q] = True
+        logger.warning(
+            "tenant %s (q%d) quarantined", self.query_names[q], q
+        )
+        self._rebuild_enforcement()
+
+    def reinstate(self, q: int) -> None:
+        """Lift query ``q``'s quarantine: columns re-enabled, lanes
+        re-activated, frozen state resumes (expired windows prune on the
+        first post-reinstatement event, exactly as a live run's would)."""
+        q = int(q)
+        if not 0 <= q < self.N or not self.iso.quarantined[q]:
+            return
+        self.iso.quarantined[q] = False
+        self.iso.throttled[q] = False  # re-verdicted next batch
+        self.iso.over[q] = ()
+        logger.info(
+            "tenant %s (q%d) reinstated", self.query_names[q], q
+        )
+        self._rebuild_enforcement()
+
+    def _rebuild_enforcement(self) -> None:
+        """Recompute the quarantine-derived structures: the disabled
+        column set (columns every user of which is quarantined), the
+        per-group member activity masks, and the screen program (the
+        disabled set is baked into the matrix closure)."""
+        quarantined = set(self.quarantined_qids)
+        self._disabled_cols = frozenset(
+            cid
+            for cid, users in self._col_users.items()
+            if users and users <= quarantined
+        )
+        self._gactive = [
+            np.asarray([q not in quarantined for q in g.qids], bool)
+            for g in self._groups
+        ]
+        self._screen_jit = self._cached_screen()
+
+    def iso_state(self) -> Dict[str, object]:
+        """The enforcement ledger for the checkpoint header."""
+        return self.iso.to_state()
+
+    def load_iso_state(self, state: Dict[str, object]) -> None:
+        """Restore the enforcement ledger (checkpoint restore / widen
+        migration) and rebuild the derived quarantine structures —
+        without firing ``quarantine.enter`` (no NEW quarantine decision
+        is being made)."""
+        self.iso.load_state(state)
+        self._rebuild_enforcement()
 
     def _assemble(self, blocks):
         """Concatenate per-group ``[n, ...]`` output blocks and permute
@@ -707,6 +1081,10 @@ class TenantBankMatcher:
                 per_q[q][TIER_COUNTER_NAMES[0]] = int(scr[r])
                 per_q[q][TIER_COUNTER_NAMES[1]] = int(fr[r])
                 per_q[q][TIER_COUNTER_NAMES[2]] = int(pr[r])
+        for q in range(self.N):
+            per_q[q]["quota_shed"] = int(self.iso.quota_shed[q])
+            per_q[q]["quota_throttled"] = int(self.iso.throttled[q])
+            per_q[q]["quarantined"] = int(self.iso.quarantined[q])
         return {
             self.query_names[q]: per_q[q] for q in range(self.N)
         }
@@ -729,6 +1107,12 @@ class TenantBankMatcher:
         )
         out["bank_prefix_shared_hit_rate"] = float(
             self.bank.stats["prefix_shared_hit_rate"]
+        )
+        out["quota_shed_total"] = int(self.iso.quota_shed.sum())
+        out["quota_throttled_queries"] = int(self.iso.throttled.sum())
+        out["quarantined_queries"] = int(self.iso.quarantined.sum())
+        out["quota_throttle_transitions"] = int(
+            self.iso.throttle_transitions
         )
         out["per_query"] = self.per_query_counters(state)
         return out

@@ -50,13 +50,132 @@ from kafkastreams_cep_tpu.utils.logging import get_logger
 logger = get_logger("runtime.ingest")
 
 #: Typed dead-letter reasons (the quarantine policy table, README
-#: "Graceful ingestion").
+#: "Graceful ingestion").  This tuple and :data:`REASON_DOCS` are the
+#: SINGLE source of truth for the DLQ reason enum: the Prometheus
+#: ``dead_letters_total{reason=...}`` label values (utils/telemetry.py
+#: renders the ``dead_letters`` snapshot key), and the README policy
+#: table (:func:`policy_table_markdown` — tests assert the README embeds
+#: its output verbatim) both derive from here.  Adding a reason means
+#: adding it here, once.
 REASON_SCHEMA = "schema"
 REASON_LANE_OVERFLOW = "lane_overflow"
 REASON_TIME_RANGE = "time_range"
 REASON_LATE = "late"
+REASON_TENANT_QUOTA = "tenant_quota"
 
-REASONS = (REASON_SCHEMA, REASON_LANE_OVERFLOW, REASON_TIME_RANGE, REASON_LATE)
+REASONS = (
+    REASON_SCHEMA,
+    REASON_LANE_OVERFLOW,
+    REASON_TIME_RANGE,
+    REASON_LATE,
+    REASON_TENANT_QUOTA,
+)
+
+#: reason -> (trigger description, loss counter it lands in).  Drives the
+#: README "dead-letter policy" table; keep every member of ``REASONS``
+#: present (tests/test_tenant_isolation.py enforces the bijection).
+REASON_DOCS: Dict[str, tuple] = {
+    REASON_SCHEMA: (
+        "value tree shape, or a float in an int field, differs from the "
+        "first record",
+        "`quarantined`",
+    ),
+    REASON_LANE_OVERFLOW: (
+        "a new key past `num_lanes`",
+        "`quarantined`",
+    ),
+    REASON_TIME_RANGE: (
+        "timestamp outside int32 device time from the epoch",
+        "`quarantined`",
+    ),
+    REASON_LATE: (
+        "event time behind the watermark (or the release frontier) at "
+        "arrival",
+        "`late_dropped`",
+    ),
+    REASON_TENANT_QUOTA: (
+        "tenant over its admission token bucket, or traffic for a "
+        "quarantined tenant (runtime/tenant.py `AdmissionPolicy`)",
+        "`admission_shed` / `admission_quarantined_dropped` (per tenant)",
+    ),
+}
+
+#: Non-reason rows of the policy table (losses that never produce a dead
+#: letter but belong in the same contract).
+EXTRA_POLICY_ROWS = (
+    (
+        "—",
+        "depth-cap force-release (the record still reaches the engine, "
+        "just early)",
+        "`reorder_evictions`",
+    ),
+)
+
+
+def policy_table_markdown() -> str:
+    """Render the dead-letter policy table (README "Graceful ingestion")
+    from :data:`REASON_DOCS` — the one place the reason enum is
+    documented.  The README embeds this output verbatim."""
+    rows = [("reason", "trigger", "counter"), ("---", "---", "---")]
+    for reason in REASONS:
+        trigger, counter = REASON_DOCS[reason]
+        rows.append((f"`{reason}`", trigger, counter))
+    rows.extend(EXTRA_POLICY_ROWS)
+    return "\n".join("| " + " | ".join(r) + " |" for r in rows)
+
+
+class AdmissionLimiter:
+    """Per-tenant token buckets for record admission (the front door of
+    the `tenant_quota` shed path — ``runtime/tenant.py`` wires it ahead
+    of packing/dispatch so a flooding tenant is shed before it costs the
+    engine anything).
+
+    ``refill()`` once per batch adds ``rate_per_batch`` tokens to every
+    known bucket (capped at ``burst``); ``admit(tenant)`` spends one.
+    New tenants start with a full burst.  Pure deterministic host state:
+    :meth:`to_state` round-trips through the checkpoint header and
+    replays identically from the supervisor journal.
+    """
+
+    def __init__(self, rate_per_batch: float, burst: Optional[float] = None):
+        if rate_per_batch < 0:
+            raise ValueError(
+                f"rate_per_batch must be >= 0, got {rate_per_batch}"
+            )
+        self.rate = float(rate_per_batch)
+        self.burst = float(burst) if burst is not None else max(
+            1.0, 2.0 * self.rate
+        )
+        self.tokens: Dict[str, float] = {}
+
+    def refill(self) -> None:
+        for tenant in self.tokens:
+            self.tokens[tenant] = min(
+                self.burst, self.tokens[tenant] + self.rate
+            )
+
+    def admit(self, tenant: str) -> bool:
+        bucket = self.tokens.get(tenant)
+        if bucket is None:
+            bucket = self.burst
+        if bucket < 1.0:
+            self.tokens[tenant] = bucket
+            return False
+        self.tokens[tenant] = bucket - 1.0
+        return True
+
+    def to_state(self) -> Dict[str, Any]:
+        return {
+            "rate": self.rate,
+            "burst": self.burst,
+            "tokens": dict(self.tokens),
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "AdmissionLimiter":
+        lim = cls(state["rate"], state["burst"])
+        lim.tokens = {str(k): float(v) for k, v in state["tokens"].items()}
+        return lim
 
 
 @dataclasses.dataclass(frozen=True)

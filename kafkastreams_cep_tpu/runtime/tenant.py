@@ -18,6 +18,30 @@ checkpoint-every-N / restore-replay-retry loop of
 ``runtime/supervisor.py`` scoped to the tenant runtime — replayed
 batches' matches are suppressed (already emitted by the pre-fault
 incarnation), so a recovered stream is exactly-once.
+
+Per-tenant isolation (the enforcement stack, outermost first):
+
+* **Admission shedding** — :class:`AdmissionPolicy` puts a per-tenant
+  token bucket (``runtime/ingest.py: AdmissionLimiter``) at the front
+  door: a flooding tenant's records are shed *before* packing or
+  dispatch, dead-lettered under the typed ``tenant_quota`` reason, and
+  ledgered so ``offered == admitted + shed + quarantined_dropped``
+  reconciles per tenant at any point in the stream.
+* **Quota enforcement** — declared :class:`~kafkastreams_cep_tpu.
+  compiler.multitenant.TenantQuota` budgets are enforced inside the bank
+  (``parallel/tenantbank.py: TenantIsolation``): over-budget tenants'
+  prefix fires are masked in the shared screen, counted per tenant in
+  ``quota_shed``.
+* **Quarantine** — a tenant whose predicate raises, that keeps tripping
+  capacity, or that is flagged :class:`TenantMisbehave` is circuit-broken
+  out of the bank (columns dark, lanes inert, state frozen for
+  :meth:`TenantCEP.reinstate`); the rest of the bank is bit-identical to
+  a bank that never contained it.
+* **Isolated escalation** — capacity trips are attributed per query;
+  :class:`TenantSupervisor` refuses a bank-wide widening whose
+  responsible tenant is over its declared share
+  (``tenant_escalation_denied``), quarantining repeat offenders instead
+  of letting one tenant grow everyone's engine.
 """
 
 from __future__ import annotations
@@ -28,12 +52,32 @@ import io
 import os
 import pickle
 import tempfile
-from typing import Any, Dict, Hashable, List, Optional, Sequence as Seq, Tuple
+import time
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Hashable,
+    List,
+    Optional,
+    Sequence as Seq,
+    Tuple,
+)
 
 import jax
 import numpy as np
 
-from kafkastreams_cep_tpu.engine.matcher import EngineConfig, EventBatch
+from kafkastreams_cep_tpu.engine.matcher import (
+    ArrayStates,
+    EngineConfig,
+    EventBatch,
+)
+from kafkastreams_cep_tpu.engine.predmatrix import owner_states
+from kafkastreams_cep_tpu.engine.sizing import (
+    EscalationPolicy,
+    capacity_counters,
+    escalate,
+)
 from kafkastreams_cep_tpu.parallel.tenantbank import (
     TenantBankMatcher,
     TenantState,
@@ -43,6 +87,12 @@ from kafkastreams_cep_tpu.runtime.checkpoint import (
     _flatten_state,
     _unflatten_state,
 )
+from kafkastreams_cep_tpu.runtime.ingest import (
+    REASON_TENANT_QUOTA,
+    AdmissionLimiter,
+    DeadLetter,
+)
+from kafkastreams_cep_tpu.runtime.migrate import widen_state
 from kafkastreams_cep_tpu.runtime.processor import (
     InputRejected,
     Record,
@@ -59,6 +109,162 @@ TENANT_FORMAT_VERSION = 1
 _I32 = np.iinfo(np.int32)
 
 
+class TenantMisbehave(RuntimeError):
+    """A fault attributable to ONE named tenant (query).
+
+    Raised (or injected via the ``tenant.misbehave`` failpoint) when a
+    fault can be pinned on a specific tenant; ``query`` carries the
+    offender's name so :class:`TenantSupervisor` quarantines exactly that
+    tenant and recovers, instead of recovering blind and re-faulting."""
+
+    def __init__(
+        self, query: Optional[str] = None, message: Optional[str] = None
+    ):
+        super().__init__(message or f"tenant {query!r} misbehaving")
+        self.query = query
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """Record-admission rate limiting at the tenant runtime's front door.
+
+    ``rate_per_batch``    — token-bucket refill per processed batch and
+                            tenant; a tenant offering more than this
+                            sustained is shed before packing/dispatch.
+    ``burst``             — bucket capacity (default ``max(1, 2*rate)``);
+                            0 sheds a tenant's every record.
+    ``key_tenant``        — record key -> tenant id (default ``str(key)``).
+                            With one key space per tenant this is also
+                            how admission maps to bank queries by name.
+    ``shed_quarantined``  — also drop records whose tenant is currently
+                            quarantined (``quarantined_dropped`` in the
+                            ledger).  Only correct when the key space is
+                            partitioned per tenant — a shared key's
+                            records feed OTHER tenants' queries too, so
+                            the default keeps them flowing and lets the
+                            bank's compute masks do the isolation.
+    ``dead_letter_cap``   — retained shed records (FIFO), each tagged
+                            with the typed ``tenant_quota`` reason.
+    """
+
+    rate_per_batch: float
+    burst: Optional[float] = None
+    key_tenant: Optional[Callable[[Hashable], str]] = None
+    shed_quarantined: bool = False
+    dead_letter_cap: int = 1024
+
+    def __post_init__(self):
+        if self.rate_per_batch < 0:
+            raise ValueError(
+                f"rate_per_batch must be >= 0, got {self.rate_per_batch}"
+            )
+        if self.dead_letter_cap < 0:
+            raise ValueError("dead_letter_cap must be >= 0")
+
+
+class TenantAdmission:
+    """The admission front door: token buckets + the per-tenant ledger.
+
+    Deterministic host state.  The reconciliation invariant — per tenant,
+    ``offered == admitted + shed + quarantined_dropped`` — holds after
+    every :meth:`filter`; :meth:`to_state` round-trips through the
+    checkpoint header (the *policy* never does — callables come from
+    code, exactly like predicates), so the ledger survives crash/restore
+    and journal replay reproduces it bit-identically."""
+
+    def __init__(self, policy: AdmissionPolicy):
+        self.policy = policy
+        self.limiter = AdmissionLimiter(policy.rate_per_batch, policy.burst)
+        self.offered: Dict[str, int] = {}
+        self.admitted: Dict[str, int] = {}
+        self.shed: Dict[str, int] = {}
+        self.quarantined_dropped: Dict[str, int] = {}
+        self.dead_letters: List[DeadLetter] = []
+        self.batch_seq = 0
+
+    def tenant_of(self, key: Hashable) -> str:
+        fn = self.policy.key_tenant
+        return str(key) if fn is None else str(fn(key))
+
+    def _dead_letter(self, record: Record, detail: str, corr: str) -> None:
+        if self.policy.dead_letter_cap <= 0:
+            return
+        if len(self.dead_letters) >= self.policy.dead_letter_cap:
+            self.dead_letters.pop(0)
+        self.dead_letters.append(
+            DeadLetter(record, REASON_TENANT_QUOTA, detail, corr)
+        )
+
+    def filter(
+        self, records: Seq[Record], quarantined: frozenset
+    ) -> List[Record]:
+        """One batch through the front door: returns the admitted
+        records in arrival order, ledgering and dead-lettering the rest.
+        Refill happens at batch completion (consume-then-refill), so a
+        rolled-back batch replays against identical buckets."""
+        corr = f"admit-{self.batch_seq}"
+        self.batch_seq += 1
+        out: List[Record] = []
+        for rec in records:
+            t = self.tenant_of(rec.key)
+            self.offered[t] = self.offered.get(t, 0) + 1
+            if self.policy.shed_quarantined and t in quarantined:
+                _failpoint("quota.shed")
+                self.quarantined_dropped[t] = (
+                    self.quarantined_dropped.get(t, 0) + 1
+                )
+                self._dead_letter(rec, f"tenant {t!r} quarantined", corr)
+                continue
+            if not self.limiter.admit(t):
+                _failpoint("quota.shed")
+                self.shed[t] = self.shed.get(t, 0) + 1
+                self._dead_letter(
+                    rec, f"tenant {t!r} admission bucket empty", corr
+                )
+                continue
+            self.admitted[t] = self.admitted.get(t, 0) + 1
+            out.append(rec)
+        self.limiter.refill()
+        return out
+
+    def ledger(self) -> Dict[str, Dict[str, int]]:
+        tenants = sorted(
+            set(self.offered)
+            | set(self.admitted)
+            | set(self.shed)
+            | set(self.quarantined_dropped)
+        )
+        return {
+            t: {
+                "offered": self.offered.get(t, 0),
+                "admitted": self.admitted.get(t, 0),
+                "shed": self.shed.get(t, 0),
+                "quarantined_dropped": self.quarantined_dropped.get(t, 0),
+            }
+            for t in tenants
+        }
+
+    def to_state(self) -> Dict[str, Any]:
+        return {
+            "limiter": self.limiter.to_state(),
+            "offered": dict(self.offered),
+            "admitted": dict(self.admitted),
+            "shed": dict(self.shed),
+            "quarantined_dropped": dict(self.quarantined_dropped),
+            "dead_letters": [tuple(d) for d in self.dead_letters],
+            "batch_seq": self.batch_seq,
+        }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        self.limiter = AdmissionLimiter.from_state(state["limiter"])
+        self.offered = dict(state["offered"])
+        self.admitted = dict(state["admitted"])
+        self.shed = dict(state["shed"])
+        self.quarantined_dropped = dict(state["quarantined_dropped"])
+        self.dead_letters = [DeadLetter(*d) for d in state["dead_letters"]]
+        self.batch_seq = int(state["batch_seq"])
+
+
 class TenantCEP:
     """N named queries over one stream, one bank dispatch per batch.
 
@@ -67,6 +273,11 @@ class TenantCEP:
     CEPBank`).  Keys claim lanes first-seen like ``CEPProcessor`` (one
     more key than lanes raises); every query sees every record.  Values
     must share one numeric pytree structure, fixed by the first record.
+
+    ``quotas`` (name -> :class:`~kafkastreams_cep_tpu.compiler.
+    multitenant.TenantQuota`) declares per-tenant budgets the bank
+    enforces; ``admission`` puts an :class:`AdmissionPolicy` token bucket
+    ahead of packing.  Both are optional and zero-cost when absent.
     """
 
     def __init__(
@@ -77,6 +288,8 @@ class TenantCEP:
         topic: str = "stream",
         profile: Optional[Dict] = None,
         reorder: bool = True,
+        quotas: Optional[Dict] = None,
+        admission: Optional[AdmissionPolicy] = None,
     ):
         if not patterns:
             raise ValueError("a tenant bank needs at least one pattern")
@@ -84,9 +297,14 @@ class TenantCEP:
         self.batch = TenantBankMatcher(
             list(patterns.values()), num_lanes, config,
             profile=profile, reorder=reorder, names=self.query_names,
+            quotas=quotas,
         )
         self.num_lanes = int(num_lanes)
         self.topic = topic
+        self.admission = (
+            TenantAdmission(admission) if admission is not None else None
+        )
+        self.quarantine_reasons: Dict[str, str] = {}
         self.state: TenantState = self.batch.init_state()
         self._lane_of: Dict[Hashable, int] = {}
         self._key_of: Dict[int, Hashable] = {}
@@ -127,9 +345,33 @@ class TenantCEP:
         """One micro-batch through the whole bank.  Returns
         ``(query_name, key, Sequence)`` triples — queries in declaration
         order, each query's matches in arrival-then-queue order."""
+        _failpoint("tenant.misbehave")
         records = list(records)
         if not records:
             return []
+        if self.admission is None:
+            return self._process_admitted(records)
+        # Admission is atomic per batch: any raise — an injected
+        # ``quota.shed``, a trace-time predicate failure inside the scan
+        # — rolls the ledger back, so a retried or replayed batch meets
+        # identical buckets and the reconciliation invariant never
+        # observes a half-counted batch.
+        snap = self.admission.to_state()
+        try:
+            admitted = self.admission.filter(
+                records, frozenset(self.quarantined_names())
+            )
+            if not admitted:
+                self.batches += 1
+                return []
+            return self._process_admitted(admitted)
+        except BaseException:
+            self.admission.load_state(snap)
+            raise
+
+    def _process_admitted(
+        self, records: List[Record]
+    ) -> List[Tuple[str, Hashable, Sequence]]:
         events, rank_of = self._pack(records)
         _failpoint("device.dispatch")
         self.state, out = self.batch.scan(self.state, events)
@@ -218,6 +460,75 @@ class TenantCEP:
             rank_of,
         )
 
+    # -- quarantine / poison probing ------------------------------------------
+
+    def _qid(self, name: str) -> int:
+        try:
+            return self.query_names.index(name)
+        except ValueError:
+            raise KeyError(f"no query named {name!r}") from None
+
+    def quarantine(self, name: str, reason: str = "manual") -> None:
+        """Circuit-break query ``name`` out of the bank (see
+        :meth:`~kafkastreams_cep_tpu.parallel.tenantbank.
+        TenantBankMatcher.quarantine`); ``reason`` is recorded for the
+        checkpoint header and telemetry."""
+        self.batch.quarantine(self._qid(name))
+        self.quarantine_reasons[name] = str(reason)
+
+    def reinstate(self, name: str) -> None:
+        """Lift ``name``'s quarantine; its frozen state resumes."""
+        self.batch.reinstate(self._qid(name))
+        self.quarantine_reasons.pop(name, None)
+
+    def quarantined_names(self) -> List[str]:
+        return [
+            self.query_names[q] for q in self.batch.quarantined_qids
+        ]
+
+    def find_poison(self) -> List[str]:
+        """Host-probe every live screen column's predicate on a tiny
+        synthetic batch; return the names of queries referencing a
+        raising column.
+
+        This attributes trace-time predicate failures (the way a
+        poisoned tenant predicate actually surfaces — the scan raises
+        before any state moves) to tenants, so the supervisor can
+        quarantine the offender instead of retrying into the same raise
+        forever.  Columns already dark under quarantine are skipped; a
+        runtime that has not seen a record yet cannot probe (no value
+        schema) and reports nothing."""
+        if self._value_proto is None:
+            return []
+        leaves, treedef = jax.tree_util.tree_flatten(self._value_proto)
+        value = jax.tree_util.tree_unflatten(
+            treedef,
+            [
+                np.zeros(
+                    (1, 1),
+                    np.float32 if isinstance(p, float) else np.int32,
+                )
+                for p in leaves
+            ],
+        )
+        key = np.zeros((1, 1), np.int32)
+        ts = np.zeros((1, 1), np.int32)
+        bad: set = set()
+        tables = [qp.tables for qp in self.batch.bank.queries]
+        for ci, col in enumerate(self.batch.bank.columns):
+            if ci in self.batch._disabled_cols:
+                continue
+            env = (
+                ArrayStates({})
+                if col.shared
+                else owner_states(tables[col.owner])
+            )
+            try:
+                np.asarray(col.pred(key, value, ts, env))
+            except Exception:
+                bad |= self.batch._col_users.get(ci, set())
+        return sorted(self.query_names[q] for q in bad)
+
     # -- telemetry ------------------------------------------------------------
 
     def counters(self) -> Dict[str, int]:
@@ -229,8 +540,28 @@ class TenantCEP:
     def per_query_counters(self) -> Dict[str, Dict[str, int]]:
         return self.batch.per_query_counters(self.state)
 
+    def admission_ledger(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant ``offered/admitted/shed/quarantined_dropped``
+        (empty without an :class:`AdmissionPolicy`)."""
+        return {} if self.admission is None else self.admission.ledger()
+
     def metrics_snapshot(self) -> Dict[str, object]:
-        return self.batch.metrics_snapshot(self.state)
+        out = self.batch.metrics_snapshot(self.state)
+        if self.admission is not None:
+            ledger = self.admission.ledger()
+            for name in ("offered", "admitted", "shed",
+                         "quarantined_dropped"):
+                out[f"admission_{name}_total"] = sum(
+                    row[name] for row in ledger.values()
+                )
+            # Rendered as ``dead_letters_total{reason=...}`` by
+            # utils/telemetry.py — same contract as the ingest guard's.
+            reasons: Dict[str, int] = {}
+            for d in self.admission.dead_letters:
+                reasons[d.reason] = reasons.get(d.reason, 0) + 1
+            out["dead_letters"] = reasons
+            out["dead_letter_depth"] = len(self.admission.dead_letters)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +596,17 @@ def save_tenant_checkpoint(
         "events": [dict(d) for d in tenant._events],
         "value_proto": tenant._value_proto,
         "batches": tenant.batches,
+        # Isolation bookkeeping (additive — readers default when absent,
+        # so the format version stays 1).  The admission POLICY is never
+        # pickled: callables come from code, like predicates; only the
+        # deterministic ledger/bucket state rides along.
+        "isolation": tenant.batch.iso_state(),
+        "quarantine_reasons": dict(tenant.quarantine_reasons),
+        "admission": (
+            tenant.admission.to_state()
+            if tenant.admission is not None
+            else None
+        ),
     }
     buf = io.BytesIO()
     np.savez(buf, **arrays)
@@ -314,12 +656,16 @@ def restore_tenant(
     patterns: Dict[str, object],
     path: str,
     ckpt: Optional[Dict[str, Any]] = None,
+    **tenant_kwargs,
 ) -> TenantCEP:
     """Rebuild a tenant runtime from user code + a checkpoint.
 
     Patterns are compiled fresh (predicates and folds come from code);
     the checkpoint supplies state only.  A bank whose query names or any
-    query's stage names differ from the snapshot is refused."""
+    query's stage names differ from the snapshot is refused.
+    ``tenant_kwargs`` (quotas, admission policy, ...) are the code-side
+    configuration and forward to :class:`TenantCEP` — the snapshot's
+    isolation ledger and admission state are applied on top."""
     if ckpt is None:
         ckpt = load_tenant_checkpoint(path)
     header = ckpt["header"]
@@ -329,9 +675,9 @@ def restore_tenant(
             f"{header['query_names']}"
         )
     config = EngineConfig(**header["config"])
-    tenant = TenantCEP(
-        patterns, header["num_lanes"], config, topic=header["topic"]
-    )
+    kwargs = dict(tenant_kwargs)
+    kwargs.setdefault("topic", header["topic"])
+    tenant = TenantCEP(patterns, header["num_lanes"], config, **kwargs)
     for q, name in enumerate(tenant.query_names):
         want = list(header["stage_names"][name])
         got = list(tenant.batch.names_of(q))
@@ -347,6 +693,13 @@ def restore_tenant(
     tenant._events = [dict(d) for d in header["events"]]
     tenant._value_proto = header["value_proto"]
     tenant.batches = int(header["batches"])
+    iso = header.get("isolation")
+    if iso is not None:
+        tenant.batch.load_iso_state(iso)
+    tenant.quarantine_reasons = dict(header.get("quarantine_reasons", {}))
+    adm = header.get("admission")
+    if adm is not None and tenant.admission is not None:
+        tenant.admission.load_state(adm)
     logger.info(
         "restored tenant runtime from %s: %d queries, %d keys assigned",
         path, len(tenant.query_names), len(tenant._lane_of),
@@ -357,6 +710,21 @@ def restore_tenant(
 # ---------------------------------------------------------------------------
 # Supervisor: checkpoint-every-N + restore / replay / retry
 # ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantinePolicy:
+    """When repeated per-tenant misbehavior hardens into quarantine.
+
+    ``trip_streak`` — consecutive denied escalations (capacity trips by
+    a tenant over its declared quota) before that tenant is quarantined
+    outright; the streak resets whenever the tenant trips nothing."""
+
+    trip_streak: int = 3
+
+    def __post_init__(self):
+        if self.trip_streak < 1:
+            raise ValueError("trip_streak must be >= 1")
 
 
 class TenantSupervisor:
@@ -370,7 +738,28 @@ class TenantSupervisor:
     incarnation already emitted them — the exactly-once contract), and
     retries the failing batch up to ``max_retries`` times.  Deterministic
     input rejection (:class:`InputRejected`) short-circuits: the batch is
-    bad, not the device, and state was untouched."""
+    bad, not the device, and state was untouched.
+
+    Blast-radius containment: a :class:`TenantMisbehave` fault
+    quarantines the named tenant before recovery; any other fault is
+    first probed with :meth:`TenantCEP.find_poison` so a raising tenant
+    predicate quarantines its owner instead of re-faulting every retry.
+    Quarantine decisions live supervisor-side (``quarantines``) and are
+    re-applied after every restore, so a decision made after the last
+    snapshot survives recovery.  Retries and recovery attempts back off
+    exponentially with deterministic jitter — the same discipline (and
+    counter, ``retry_backoff_ms_total``) as ``runtime/supervisor.py:
+    Supervisor._backoff``; ``retry_backoff_ms=0`` restores the
+    historical immediate retry.
+
+    Isolated escalation: with ``auto_escalate`` set, capacity trips are
+    attributed per query via counter deltas; a bank-wide widening whose
+    every responsible tenant is within quota proceeds (state migrated
+    live via ``runtime/migrate.py: widen_state``, then pinned with an
+    immediate checkpoint), while a trip driven by an over-quota tenant
+    is refused (``tenant_escalation_denied``) and, after
+    ``quarantine_policy.trip_streak`` consecutive denials, the offender
+    is quarantined — one tenant cannot grow everyone's engine."""
 
     def __init__(
         self,
@@ -380,6 +769,10 @@ class TenantSupervisor:
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 16,
         max_retries: int = 1,
+        retry_backoff_ms: float = 50.0,
+        retry_backoff_cap_ms: float = 5000.0,
+        auto_escalate: Optional[EscalationPolicy] = None,
+        quarantine_policy: QuarantinePolicy = QuarantinePolicy(),
         **tenant_kwargs,
     ):
         self._patterns = dict(patterns)
@@ -393,11 +786,23 @@ class TenantSupervisor:
         )
         self.checkpoint_every = int(checkpoint_every)
         self.max_retries = int(max_retries)
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.retry_backoff_cap_ms = float(retry_backoff_cap_ms)
+        self.retry_backoff_ms_total = 0.0
+        self._sleep = time.sleep  # tests patch this
+        self.auto_escalate = auto_escalate
+        self.quarantine_policy = quarantine_policy
+        self.quarantines: Dict[str, str] = {}
+        self._denial_streak: Dict[str, int] = {}
+        self._pq_base: Optional[Dict[str, Dict[str, int]]] = None
         self._journal: List[List[Record]] = []
         self._has_checkpoint = False
         self.recoveries = 0
         self.checkpoints = 0
         self.checkpoint_failures = 0
+        self.escalations = 0
+        self.tenant_escalation_denied = 0
+        self.tenant_quarantines = 0
 
     def process(
         self, records: Seq[Record]
@@ -410,19 +815,183 @@ class TenantSupervisor:
                 break
             except InputRejected:
                 raise
+            except TenantMisbehave as e:
+                # Attributed fault: isolate exactly the offender, then
+                # recover — the rest of the bank keeps its state.
+                last_err = e
+                logger.warning(
+                    "tenant misbehaving (%s); quarantining and "
+                    "recovering (attempt %d/%d)",
+                    e, attempt + 1, self.max_retries,
+                )
+                self._quarantine_for(e.query, "misbehave")
+                if attempt < self.max_retries:
+                    self._backoff(attempt)
+                self._recover()
             except Exception as e:  # device fault: recover and retry
                 last_err = e
                 logger.warning(
                     "batch failed (%s: %s); recovering (attempt %d/%d)",
                     type(e).__name__, e, attempt + 1, self.max_retries,
                 )
+                # A raising tenant predicate would re-fault every retry:
+                # probe and quarantine the owner before recovering.
+                try:
+                    poisoned = self.tenant.find_poison()
+                except Exception:
+                    poisoned = []
+                for name in poisoned:
+                    self._quarantine_for(name, "predicate_raise")
+                if attempt < self.max_retries:
+                    self._backoff(attempt)
                 self._recover()
         else:
             raise last_err  # retries exhausted
         self._journal.append(records)
+        self._maybe_escalate()
         if len(self._journal) >= self.checkpoint_every:
             self.checkpoint()
         return matches
+
+    def _backoff(self, attempt: int) -> None:
+        """Exponential-in-attempt, capped, deterministically jittered —
+        ``(batches + 1, attempt)`` seeds the jitter so a replayed chaos
+        schedule waits identically (the ``runtime/supervisor.py``
+        retry-backoff discipline, scoped to the tenant runtime)."""
+        if self.retry_backoff_ms <= 0:
+            return
+        delay_ms = min(
+            self.retry_backoff_cap_ms,
+            self.retry_backoff_ms * (2.0 ** attempt),
+        )
+        rng = np.random.default_rng((self.tenant.batches + 1, attempt))
+        delay_ms *= 0.5 + 0.5 * float(rng.random())  # jitter in [0.5, 1.0)
+        self.retry_backoff_ms_total += delay_ms
+        logger.info(
+            "retry backoff: %.1f ms before attempt %d",
+            delay_ms, attempt + 2,
+        )
+        self._sleep(delay_ms / 1000.0)
+
+    # -- quarantine bookkeeping ----------------------------------------------
+
+    def _quarantine_for(self, name: Optional[str], reason: str) -> None:
+        """Record a quarantine decision (supervisor-side authoritative —
+        re-applied after every restore) and apply it to the live bank.
+        An unattributed fault (no tenant name) isolates nothing."""
+        if name is None or name not in self._patterns:
+            return
+        if name in self.quarantines:
+            return
+        self.quarantines[name] = str(reason)
+        self.tenant_quarantines += 1
+        try:
+            self.tenant.quarantine(name, reason)
+        except Exception as e:
+            # quarantine.enter contract: a fault here leaves the bank
+            # un-quarantined and live; the recorded decision re-applies
+            # on the next recovery.
+            logger.warning(
+                "quarantine of %r deferred (%s: %s); re-applied on "
+                "recovery", name, type(e).__name__, e,
+            )
+
+    def reinstate(self, name: str) -> None:
+        """Lift a quarantine: clears the supervisor-side decision (so
+        recovery stops re-applying it) and the bank's enforcement."""
+        self.quarantines.pop(name, None)
+        self._denial_streak.pop(name, None)
+        self.tenant.reinstate(name)
+
+    # -- isolated escalation ---------------------------------------------------
+
+    def _maybe_escalate(self) -> None:
+        """Per-tenant-attributed auto-widening after a clean batch.
+
+        Capacity-counter deltas since the last check attribute each trip
+        to its query; if every tripping tenant is within its declared
+        quota, the whole bank widens (the shared-engine reality: knobs
+        are bank-wide) — otherwise the widening is DENIED and charged to
+        the over-quota tenants, quarantining streak offenders."""
+        if self.auto_escalate is None:
+            return
+        pq = self.tenant.per_query_counters()
+        base = self._pq_base or {}
+        self._pq_base = pq
+        tripping: Dict[str, Dict[str, int]] = {}
+        for name, counters in pq.items():
+            prev = base.get(name, {})
+            deltas = {
+                c: v - prev.get(c, 0)
+                for c, v in capacity_counters(counters).items()
+                if v - prev.get(c, 0) > 0
+            }
+            if deltas:
+                tripping[name] = deltas
+        if not tripping:
+            for name in list(self._denial_streak):
+                self._denial_streak.pop(name)
+            return
+        iso = self.tenant.batch.iso
+        over = [
+            name
+            for name in tripping
+            if iso.over[self.tenant._qid(name)]
+        ]
+        for name in list(self._denial_streak):
+            if name not in over:
+                self._denial_streak.pop(name)
+        if over:
+            self.tenant_escalation_denied += 1
+            logger.warning(
+                "escalation denied: capacity trips %s attributed to "
+                "over-quota tenants %s",
+                {n: d for n, d in tripping.items()}, over,
+            )
+            for name in over:
+                streak = self._denial_streak.get(name, 0) + 1
+                self._denial_streak[name] = streak
+                if streak >= self.quarantine_policy.trip_streak:
+                    self._quarantine_for(name, "capacity")
+            return
+        merged: Dict[str, int] = {}
+        for deltas in tripping.values():
+            for c, v in deltas.items():
+                merged[c] = merged.get(c, 0) + v
+        new_cfg = escalate(
+            self.tenant.batch.config, merged, self.auto_escalate
+        )
+        if new_cfg is None:
+            return  # every tripped dimension at its ceiling
+        logger.warning(
+            "escalating bank config for compliant trips %s", merged
+        )
+        self._widen(new_cfg)
+        self.escalations += 1
+
+    def _widen(self, new_cfg: EngineConfig) -> None:
+        """Live-migrate the whole bank into ``new_cfg`` shapes
+        (``widen_state`` — counters and live runs survive bit-for-bit)
+        and pin the widened incarnation with an immediate checkpoint so
+        recovery never narrows back (forward-only)."""
+        old = self.tenant
+        new = TenantCEP(
+            self._patterns, old.num_lanes, new_cfg,
+            **self._tenant_kwargs,
+        )
+        new.state = widen_state(old.state, old.batch.config, new_cfg)
+        new._lane_of = dict(old._lane_of)
+        new._key_of = dict(old._key_of)
+        new._next_offset = old._next_offset.copy()
+        new._events = [dict(d) for d in old._events]
+        new._value_proto = old._value_proto
+        new.batches = old.batches
+        new.batch.load_iso_state(old.batch.iso_state())
+        new.quarantine_reasons = dict(old.quarantine_reasons)
+        if new.admission is not None and old.admission is not None:
+            new.admission.load_state(old.admission.to_state())
+        self.tenant = new
+        self.checkpoint()
 
     def checkpoint(self) -> None:
         """Snapshot now (atomic rename) and truncate the journal."""
@@ -452,20 +1021,32 @@ class TenantSupervisor:
 
         Replay runs through the same device failure sites as live
         traffic, so recovery itself can fault mid-replay; the recovered
-        tenant is only committed once restore + full replay succeed."""
+        tenant is only committed once restore + full replay succeed.
+        Failed attempts back off with the same deterministic exponential
+        schedule as batch retries (``runtime/supervisor.py`` discipline
+        — the historical immediate-retry loop hammered a faulting device
+        32 times back-to-back).  Supervisor-side quarantine decisions
+        are re-applied before replay, so a tenant quarantined after the
+        last snapshot stays isolated through recovery — and its replay
+        traffic is masked exactly as live traffic was."""
         self.recoveries += 1
         last_err: Optional[BaseException] = None
-        for _ in range(32):
+        for attempt in range(32):
+            if attempt:
+                self._backoff(attempt - 1)
             try:
                 if self._has_checkpoint:
                     tenant = restore_tenant(
-                        self._patterns, self.checkpoint_path
+                        self._patterns, self.checkpoint_path,
+                        **self._tenant_kwargs,
                     )
                 else:
                     tenant = TenantCEP(
                         self._patterns, self.tenant.num_lanes,
                         self.tenant.batch.config, **self._tenant_kwargs,
                     )
+                for name, reason in self.quarantines.items():
+                    tenant.quarantine(name, reason)
                 for batch in self._journal:
                     # Replay is deterministic; matches were already
                     # emitted by the pre-fault incarnation, so they are
@@ -485,9 +1066,21 @@ class TenantSupervisor:
     def counters(self) -> Dict[str, int]:
         return self.tenant.counters()
 
+    def per_query_counters(self) -> Dict[str, Dict[str, int]]:
+        return self.tenant.per_query_counters()
+
+    def admission_ledger(self) -> Dict[str, Dict[str, int]]:
+        return self.tenant.admission_ledger()
+
     def metrics_snapshot(self) -> Dict[str, object]:
         out = self.tenant.metrics_snapshot()
         out["recoveries"] = self.recoveries
         out["checkpoints"] = self.checkpoints
         out["checkpoint_failures"] = self.checkpoint_failures
+        out["escalations"] = self.escalations
+        out["tenant_escalation_denied"] = self.tenant_escalation_denied
+        out["tenant_quarantines"] = self.tenant_quarantines
+        out["retry_backoff_ms_total"] = round(
+            self.retry_backoff_ms_total, 3
+        )
         return out

@@ -58,6 +58,18 @@ Sites currently threaded through the runtime:
 ``rebalance.move``     entry of ``runtime.migrate.move_lanes``, before any
                        state moves — a fault here must leave the old
                        processor (and lane assignment) fully intact
+``tenant.misbehave``   entry of ``runtime.tenant.TenantCEP.process``,
+                       before admission, packing, or any state mutation —
+                       arm with ``runtime.tenant.TenantMisbehave`` to flag
+                       a tenant for supervisor quarantine
+``quota.shed``         the admission shed path of ``runtime.tenant.
+                       TenantAdmission`` (token bucket empty or traffic
+                       for a quarantined tenant), before the dead letter
+                       and shed ledger entries are recorded
+``quarantine.enter``   entry of ``parallel.tenantbank.TenantBankMatcher.
+                       quarantine``, before any enforcement state flips —
+                       a fault here must leave the bank un-quarantined
+                       and fully live
 =====================  ====================================================
 """
 
@@ -236,6 +248,12 @@ SITES = (
     # deriving the new plan and committing the rebuilt processor — a
     # crash here must leave the old plan fully live (replan_failures).
     "replan.swap",
+    # Per-tenant isolation sites (runtime/tenant.py admission shedding +
+    # supervisor quarantine, parallel/tenantbank.py enforcement; see the
+    # docstring table).
+    "tenant.misbehave",
+    "quota.shed",
+    "quarantine.enter",
 )
 
 

@@ -53,6 +53,8 @@ def pytest_collection_modifyitems(config, items):
     """
     def _age(it):
         nid = it.nodeid
+        if "test_tenant_isolation" in nid:
+            return 4  # PR 11: per-tenant isolation
         if "test_multitenant" in nid:
             return 3  # PR 9: multi-tenant query bank
         if (

@@ -181,3 +181,39 @@ def test_gate_guards_tenant_bank_flags():
             bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r06.json"))
         ) or {}
     )
+
+
+def test_gate_guards_tenant_iso_flags():
+    """From BENCH_r09 on, the nested ``resilience.tenant`` block's
+    isolation flags flatten into guarded ``tenant_iso_*`` flags: with one
+    tenant flooding past its quota, the compliant tenants' matches must
+    stay bit-equal to the unquotaed clean bank's (parity) and lose
+    nothing to shedding (compliant_lossfree) — a later round may not
+    regress either (ISSUE 17 satellite)."""
+    r09 = bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r09.json"))
+    m = bench_gate.extract_metrics(r09)
+    assert m["tenant_iso_parity"] is True
+    assert m["tenant_iso_compliant_lossfree"] is True
+    bad = json.loads(json.dumps(r09))
+    bad["parsed"]["resilience"]["tenant"]["parity"] = False
+    ok, report = bench_gate.gate(bad, [r09])
+    assert not ok
+    assert any(
+        c["metric"] == "tenant_iso_parity" and not c["ok"]
+        for c in report["checks"]
+    )
+    lossy = json.loads(json.dumps(r09))
+    lossy["parsed"]["resilience"]["tenant"]["compliant_lossfree"] = False
+    ok, report = bench_gate.gate(lossy, [r09])
+    assert not ok
+    assert any(
+        c["metric"] == "tenant_iso_compliant_lossfree" and not c["ok"]
+        for c in report["checks"]
+    )
+    # Rounds predating the resilience.tenant block stay unguarded on
+    # these flags, so the historical trajectory replays clean.
+    assert "tenant_iso_parity" not in (
+        bench_gate.extract_metrics(
+            bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r08.json"))
+        ) or {}
+    )
