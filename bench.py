@@ -50,8 +50,11 @@ default 1; ``CEP_BENCH_ADAPT_{K,T,CHUNK,REPS,DRIFT_B}`` size it),
 ``CEP_BENCH_TENANT_ISO`` (per-tenant isolation: compliant-tenant
 throughput with one quota-limited flooding tenant, shed accounting, and
 quarantine-entry latency, default 1;
-``CEP_BENCH_TENANT_ISO_{K,B,BATCHES}`` size it), ``CEP_PLATFORM``
-(force a JAX platform, e.g. ``cpu``).
+``CEP_BENCH_TENANT_ISO_{K,B,BATCHES}`` size it), ``CEP_BENCH_LATENCY``
+(end-to-end latency attribution: ledger on/off parity + overhead,
+per-segment p50/p99, drain-cadence and reorder-grace A/Bs, default 1;
+``CEP_BENCH_LATENCY_{K,B,BATCHES,GRACE,DRAIN,RING}`` size it),
+``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -2189,6 +2192,165 @@ def bench_tenant_iso():
     return out
 
 
+def bench_latency():
+    """``CEP_BENCH_LATENCY``: end-to-end latency attribution (ISSUE 18).
+
+    The segment ledger on the record-path processor, three ways:
+
+    * **Ledger A/B** — the same in-order stream with the ledger off vs
+      on: matches and loss counters must stay bit-identical
+      (``parity``, guarded by bench_gate once recorded) and the
+      host-side stamping cost is reported (``ledger_overhead_pct``);
+    * **Drain-cadence A/B** — ``drain_interval`` 1 vs ``D`` under lazy
+      extraction: deferral trades emit latency (the ``drain_defer``
+      segment) for fewer device_get round-trips, and the ledger makes
+      the trade visible per segment instead of folded into e2e;
+    * **Reorder-grace A/B** — watermark guard with grace 0 vs ``G`` ms
+      on the same in-order stream: the grace window surfaces as
+      ``reorder_hold`` p99, the latency price of skew tolerance.
+
+    ``e2e_p99_s`` (the ledgered baseline's end-to-end p99) joins
+    bench_gate as a lower-is-better ceiling.  Record-path rates are
+    host-bound (µs/record Python), so the overhead number is relative,
+    like bench_ooo's.  ``CEP_BENCH_LATENCY_{K,B,BATCHES,GRACE,DRAIN,RING}``
+    size it.
+    """
+    from kafkastreams_cep_tpu.runtime import CEPProcessor, IngestPolicy, Record
+    from kafkastreams_cep_tpu.utils.latency import LatencyLedger
+
+    K = int(os.environ.get("CEP_BENCH_LATENCY_K", "64"))
+    n_batches = int(os.environ.get("CEP_BENCH_LATENCY_BATCHES", "8"))
+    batch_records = int(os.environ.get("CEP_BENCH_LATENCY_B", "2048"))
+    grace = int(os.environ.get("CEP_BENCH_LATENCY_GRACE", "64"))
+    drain = int(os.environ.get("CEP_BENCH_LATENCY_DRAIN", "8"))
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    # The cadence A/B runs BOTH sides on this config so only
+    # drain_interval differs: deferral parks completed chains and match
+    # handles until the drain, so it needs slab headroom (2x, like the
+    # lazy A/B's default) and a ring sized for `drain` batches of
+    # handles — otherwise the comparison measures drop policy, not
+    # scheduling, and parity stops meaning "cadence is pure scheduling".
+    lazy_cfg = EngineConfig(
+        max_runs=24, slab_entries=96, slab_preds=8, dewey_depth=12,
+        max_walk=12, lazy_extraction=True,
+        handle_ring=int(os.environ.get("CEP_BENCH_LATENCY_RING", "512")),
+    )
+    rng = np.random.default_rng(18)
+    N = n_batches * batch_records
+    keys = rng.integers(0, K, size=N)
+    prices = rng.integers(90, 131, size=N)
+    vols = np.where(
+        rng.random(N) < 0.005, 1100, rng.integers(700, 1000, size=N)
+    )
+    ts = np.arange(N, dtype=np.int64) * 2  # distinct event times
+    recs = [
+        Record(
+            int(keys[i]),
+            {"price": int(prices[i]), "volume": int(vols[i])},
+            int(ts[i]),
+            offset=i,
+        )
+        for i in range(N)
+    ]
+
+    def canon(matches):
+        # Emission order differs across drain cadences (deferred matches
+        # flush late) and the ingest guard renumbers offsets per lane, so
+        # parity compares the sorted canonical set keyed by event time —
+        # globally distinct in this stream by construction.
+        return sorted(
+            (k, tuple(sorted(
+                (st, e.timestamp)
+                for st, evs in seq.as_map().items()
+                for e in evs
+            )))
+            for k, seq in matches
+        )
+
+    def run(policy, drain_interval, config, ledger):
+        proc = CEPProcessor(
+            stock_demo.stock_pattern(), K, config, epoch=0, ingest=policy,
+            drain_interval=drain_interval, latency=ledger,
+        )
+        warm = min(2, n_batches - 1)
+        matches = []
+        for b in range(warm):
+            matches += proc.process(
+                recs[b * batch_records:(b + 1) * batch_records]
+            )
+        t0 = time.perf_counter()  # host-timed (record path is host-bound)
+        for b in range(warm, n_batches):
+            matches += proc.process(
+                recs[b * batch_records:(b + 1) * batch_records]
+            )
+        matches += proc.drain_ingest()
+        matches += proc.flush()
+        dt = time.perf_counter() - t0
+        return proc, canon(matches), (n_batches - warm) * batch_records / dt
+
+    def segs(proc):
+        snap = proc.ledger.snapshot()["segments"]
+        return {
+            name: {
+                "count": s["count"],
+                "p50_s": round(s["p50"], 6),
+                "p99_s": round(s["p99"], 6),
+            }
+            for name, s in snap.items() if s["count"]
+        }
+
+    out = {"records": N, "grace_ms": grace, "drain_interval": drain}
+    p_off, m_off, evps_off = run(None, 1, cfg, None)
+    p_on, m_on, evps_on = run(None, 1, cfg, LatencyLedger())
+    out["parity"] = bool(
+        m_off == m_on and p_off.counters() == p_on.counters()
+    )
+    out["matches"] = len(m_on)
+    out["evps_ledger_off"] = round(evps_off, 1)
+    out["evps_ledger_on"] = round(evps_on, 1)
+    out["ledger_overhead_pct"] = round(100 * (1 - evps_on / evps_off), 1)
+    base = segs(p_on)
+    out["segments"] = base
+    out["e2e_p99_s"] = base["e2e_total"]["p99_s"]
+
+    p_d1, m_d1, _ = run(None, 1, lazy_cfg, LatencyLedger())
+    p_dn, m_dn, _ = run(None, drain, lazy_cfg, LatencyLedger())
+    out["drain_ab"] = {
+        "interval_1": segs(p_d1),
+        f"interval_{drain}": segs(p_dn),
+    }
+    p_g0, m_g0, _ = run(IngestPolicy(grace_ms=0), 1, cfg, LatencyLedger())
+    p_gg, m_gg, _ = run(
+        IngestPolicy(grace_ms=grace), 1, cfg, LatencyLedger()
+    )
+    out["grace_ab"] = {
+        "grace_0": segs(p_g0),
+        f"grace_{grace}": segs(p_gg),
+    }
+    # Within one engine config, cadence and grace change batching and
+    # timing, never the match set: the guard releases the sorted stream
+    # and the final flush drains every deferral.  (Across configs the
+    # slab headroom itself shifts the drop policy, so the eager and lazy
+    # sides are not compared to each other.)
+    out["ab_match_parity"] = bool(
+        m_d1 == m_dn and m_on == m_g0 == m_gg
+    )
+    log(
+        f"latency ({N} records, {K} lanes): ledger overhead "
+        f"{out['ledger_overhead_pct']}% ({out['evps_ledger_off']} -> "
+        f"{out['evps_ledger_on']} ev/s), parity={out['parity']}, e2e p99 "
+        f"{out['e2e_p99_s']}s; drain 1 vs {drain} defer p99 "
+        f"{segs(p_dn).get('drain_defer', {}).get('p99_s')}s; grace 0 vs "
+        f"{grace} ms hold p99 "
+        f"{segs(p_gg).get('reorder_hold', {}).get('p99_s')}s; "
+        f"ab_match_parity={out['ab_match_parity']}"
+    )
+    return out
+
+
 def bench_ooo():
     """``CEP_BENCH_OOO``: graceful-ingestion A/B (ISSUE 5).
 
@@ -2354,6 +2516,7 @@ def main():
     tier = {}
     tenants = {}
     adapt = {}
+    latency = {}
 
     def _shard_fault_block():
         # Nested under ``resilience`` so the JSON groups every
@@ -2422,6 +2585,14 @@ def main():
             (
                 "tenant-iso",
                 lambda: resilience.update(_tenant_iso_block()),
+            ),
+            (
+                "latency",
+                lambda: latency.update(
+                    bench_latency()
+                    if os.environ.get("CEP_BENCH_LATENCY", "1") == "1"
+                    else {}
+                ),
             ),
             (
                 "processor",
@@ -2576,6 +2747,12 @@ def main():
                 # parity, loss flags, replan count, lazy-chain cost win
                 # (None when extras skipped or CEP_BENCH_ADAPT=0).
                 "adapt": adapt or None,
+                # End-to-end latency attribution (ISSUE 18): per-segment
+                # p50/p99 from the ingest->emit ledger, ledger on/off
+                # match parity + overhead, drain-cadence and
+                # reorder-grace A/Bs (None when extras skipped or
+                # CEP_BENCH_LATENCY=0).
+                "latency": latency or None,
             }
         ),
         flush=True,

@@ -18,9 +18,14 @@ than the trajectory's best on any guarded metric:
   (``lossfree_counters_zero``, ``lossfree_oracle_parity``, the
   ``tier_*`` parity pair, the ``shard_*`` fault-tolerance pair —
   evacuation parity and the rebalance loss contract — and the
-  ``adapt_*`` pair — replan match parity and drift-A/B loss flags) may
-  not go true→false; ``recall_sampled`` may not drop by more than the
-  same relative tolerance.
+  ``adapt_*`` pair — replan match parity and drift-A/B loss flags, and
+  the ``latency_*`` pair — ledger on/off parity and the cadence/grace
+  scheduling parity) may not go true→false; ``recall_sampled`` may not
+  drop by more than the same relative tolerance.
+* **Latency ceilings** (lower is better): ``latency_e2e_p99_s`` (the
+  ledgered baseline's end-to-end p99) may not rise above the
+  trajectory's best by more than a wide latency-specific tolerance
+  (tail latency is noisier than throughput and log-bucket quantized).
 
 Missing metrics are skipped on either side (early rounds carry fewer
 keys), so the gate accepts the existing r01→r05 trajectory replayed
@@ -63,9 +68,18 @@ FLAG_METRICS = (
     "adapt_loss_flags",
     "tenant_iso_parity",
     "tenant_iso_compliant_lossfree",
+    "latency_parity",
+    "latency_ab_parity",
 )
 #: Ratio metrics guarded like rates (0..1, higher is better).
 RATIO_METRICS = ("recall_sampled",)
+#: Latency metrics guarded for "not meaningfully higher" (lower is
+#: better): the ledgered baseline's end-to-end p99 from the ``latency``
+#: block.  Tail latency is far noisier than throughput (log-bucket
+#: quantization alone steps ~78% between adjacent edges), so the
+#: ceiling uses its own wider relative tolerance.
+CEILING_METRICS = ("latency_e2e_p99_s",)
+CEILING_REL_TOL = 1.0
 
 
 def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -113,6 +127,20 @@ def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         flat["tenant_iso_compliant_lossfree"] = tenant_iso.get(
             "compliant_lossfree"
         )
+    latency = parsed.get("latency")
+    if isinstance(latency, dict):
+        # Nested latency block (BENCH_r10+) -> flat ``latency_*`` keys:
+        # the ledger on/off match+counter parity, the within-config
+        # cadence/grace scheduling parity, and the end-to-end p99
+        # ceiling (lower is better, CEILING_METRICS).
+        flat["latency_parity"] = latency.get("parity")
+        flat["latency_ab_parity"] = latency.get("ab_match_parity")
+        p99 = latency.get("e2e_p99_s")
+        if (
+            isinstance(p99, (int, float))
+            and not isinstance(p99, bool) and p99 > 0
+        ):
+            out["latency_e2e_p99_s"] = float(p99)
     adapt = parsed.get("adapt")
     if isinstance(adapt, dict):
         # Nested adapt block (BENCH_r08+) -> flat ``adapt_*`` keys: the
@@ -177,6 +205,27 @@ def gate(
                 "baseline_best": best[metric],
                 "tolerance": round(tol, 4),
                 "floor": round(floor, 1),
+                "ok": passed,
+            }
+        )
+    for metric in CEILING_METRICS:
+        cands = [m for m in base_ms if metric in m]
+        if not cands or metric not in new_m:
+            continue
+        best = min(cands, key=lambda m: m[metric])
+        tol = max(
+            CEILING_REL_TOL, (best["spread_pct"] + new_spread) / 100.0
+        )
+        ceiling = best[metric] * (1.0 + tol)
+        passed = new_m[metric] <= ceiling
+        ok &= passed
+        checks.append(
+            {
+                "metric": metric,
+                "new": new_m[metric],
+                "baseline_best": best[metric],
+                "tolerance": round(tol, 4),
+                "ceiling": round(ceiling, 6),
                 "ok": passed,
             }
         )

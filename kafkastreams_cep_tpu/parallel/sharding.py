@@ -13,6 +13,7 @@ by construction and scales linearly by design.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -322,11 +323,25 @@ class ShardedMatcher:
             for n, v in per_lane_counter_arrays(state).items()
         }
 
-    def metrics_snapshot(self, state: EngineState) -> Dict[str, object]:
+    def metrics_snapshot(
+        self,
+        state: EngineState,
+        watermark=None,
+        clock=None,
+        ledgers=None,
+    ) -> Dict[str, object]:
         """Mesh-global engine telemetry in one dict — the per-shard
         registries merged: the summed view rides the one-``psum`` ``stats``
         collective (each shard's counter block is its local registry; the
-        psum IS the merge), the per-lane breakdown a host gather."""
+        psum IS the merge), the per-lane breakdown a host gather.
+
+        ``watermark`` (absolute ms) adds the watermark / event-time-lag
+        gauges the unmeshed processor surfaces — through the caller's
+        injectable ``clock`` — which the meshed wrapper historically
+        omitted.  ``ledgers`` is an iterable of per-host
+        :class:`~kafkastreams_cep_tpu.utils.latency.LatencyLedger` to fold
+        into one ``latency`` entry (ledgers are host-side, so the
+        multi-host merge is the associative ``merge``, not a psum)."""
         from kafkastreams_cep_tpu.engine.matcher import TIER_COUNTER_NAMES
 
         out: Dict[str, object] = dict(self.stats(state))
@@ -338,6 +353,15 @@ class ShardedMatcher:
         per_stage = self.stage_counters(state)
         if per_stage:
             out["per_stage"] = per_stage
+        if watermark is not None:
+            now = clock if clock is not None else time.time
+            out["watermark"] = int(watermark)
+            out["event_time_lag_ms"] = int(now() * 1000) - int(watermark)
+        if ledgers:
+            merged = None
+            for led in ledgers:
+                merged = led if merged is None else merged.merge(led)
+            out["latency"] = merged.snapshot()
         return out
 
     def sweep(self, state: EngineState) -> EngineState:

@@ -1114,5 +1114,17 @@ class TenantBankMatcher:
         out["quota_throttle_transitions"] = int(
             self.iso.throttle_transitions
         )
+        # Measured dispatch gating (the PR 10 screen→NFA gate, bank form):
+        # each scan offers every engine group one dispatch opportunity;
+        # the fraction actually dispatched is the headroom number the
+        # gate-chunk autotuning roadmap item keys on.
+        out["bank_scan_calls"] = int(self.scan_calls)
+        out["bank_nfa_dispatches"] = int(self.nfa_dispatches)
+        opportunities = int(self.scan_calls) * max(len(self._groups), 1)
+        out["bank_nfa_dispatch_fraction"] = (
+            round(int(self.nfa_dispatches) / opportunities, 6)
+            if opportunities
+            else None
+        )
         out["per_query"] = self.per_query_counters(state)
         return out

@@ -21,6 +21,13 @@ Subcommands
                  per-key heavy hitters, and the measured A/B overhead of
                  attribution on the same trace — the numbers PROFILE_r08
                  records and the ≤3 %-overhead acceptance bound checks.
+``latency``      end-to-end latency attribution (ISSUE 18): drives a
+                 ledger-instrumented ``CEPProcessor`` over synthetic
+                 stock batches and reports per-segment percentiles
+                 (reorder_hold/queue/device/drain_defer/e2e_total), SLO
+                 burn, XLA ``cost_analysis()`` device-time attribution
+                 for the compiled scan, and (``--trace-dir``) an
+                 optional ``jax.profiler`` trace capture.
 
 Every subcommand accepts ``--k/--t/--reps`` size knobs and ``--platform``
 (e.g. ``cpu``) so the tier-1 smoke test can drive tiny shapes on CI.
@@ -276,7 +283,91 @@ def run_phases(args) -> Dict[str, Any]:
         ),
         slab, en_w, st_w, off_w, ver_w, vlen_w, is_rm, want,
     )
-    return {"profile": "phases", "k": K, "kernels": results}
+    gate = _measure_dispatch_gate(K, args.t, args.reps)
+    return {
+        "profile": "phases", "k": K, "kernels": results,
+        "dispatch_gate": gate,
+    }
+
+
+def _measure_dispatch_gate(K: int, T: int, reps: int) -> Dict[str, Any]:
+    """Measured chunk-gate elision (ISSUE 18 satellite): scan a tiered
+    matcher and read back the PR 10 ``gate_chunks`` / ``nfa_dispatches``
+    dispatch accounting as a fraction.  On a chunk-gated hybrid plan the
+    fraction is NFA chunks actually dispatched over chunks offered
+    (< 1.0 means the gate elided work); on whole-batch plans (pure NFA,
+    stencil, whole-scan kernel) ``gate_chunks`` stays 0 and the fraction
+    falls back to dispatches per scan call.  The stock pattern plans
+    pure-NFA (no strict prefix), so this uses a strict-prefix + Kleene
+    shape that plans hybrid, over a sparse trace where most chunks
+    promote nothing."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kafkastreams_cep_tpu import Query
+    from kafkastreams_cep_tpu.engine import EngineConfig, EventBatch
+    from kafkastreams_cep_tpu.parallel.tiered import TieredBatchMatcher
+
+    def val(code):
+        return lambda k, v, ts, st: v == code
+
+    pattern = (
+        Query()
+        .select("a").where(val(0))
+        .then()
+        .select("b").where(val(1))
+        .then()
+        .select("c").one_or_more().where(val(2))
+        .then()
+        .select("d").where(val(3))
+        .build()
+    )
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12, tiering=True,
+    )
+    batch = TieredBatchMatcher(pattern, K, cfg)
+    # Noise everywhere, a full a,b,c,d match planted at the head of every
+    # OTHER gate_chunk-sized segment: promoting chunks must dispatch,
+    # quiet chunks must be elided, so the measured fraction sits mid-range
+    # by construction (~0.5) instead of degenerating to 0 or 1.
+    C = max(int(cfg.gate_chunk), 1)
+    vals = np.full((K, T), 4, np.int32)
+    for c0 in range(0, T, 2 * C):
+        if c0 + 4 <= T:
+            vals[:, c0:c0 + 4] = np.array([0, 1, 2, 3], np.int32)
+    i32 = jnp.int32
+    events = EventBatch(
+        key=jnp.broadcast_to(jnp.arange(K, dtype=i32)[:, None], (K, T)),
+        value=jnp.asarray(vals),
+        ts=jnp.broadcast_to(jnp.arange(T, dtype=i32)[None, :] * 2, (K, T)),
+        off=jnp.broadcast_to(jnp.arange(T, dtype=i32)[None, :], (K, T)),
+        valid=jnp.ones((K, T), bool),
+    )
+    state = batch.init_state()
+    out = None
+    for _ in range(max(reps, 1)):
+        state, out = batch.scan(state, events)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    calls = int(batch.scan_calls)
+    chunks = int(batch.gate_chunks)
+    dispatches = int(batch.nfa_dispatches)  # the one host sync
+    denom = chunks if chunks else calls
+    row = {
+        "tier": str(batch.plan.tier),
+        "scan_calls": calls,
+        "gate_chunks": chunks,
+        "nfa_dispatches": dispatches,
+        "nfa_dispatch_fraction": (
+            round(dispatches / denom, 4) if denom else None
+        ),
+    }
+    _log(
+        f"dispatch_gate: tier={row['tier']} chunks={chunks} "
+        f"nfa_dispatches={dispatches} fraction={row['nfa_dispatch_fraction']}"
+    )
+    return row
 
 
 # ---------------------------------------------------------------------------
@@ -485,6 +576,124 @@ def run_selectivity(args) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# latency — end-to-end latency attribution (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def _cost_analysis(jfn, *fargs) -> Dict[str, Any]:
+    """XLA cost-analysis row for one compiled program ({} when the
+    backend exposes none — e.g. some CPU builds)."""
+    try:
+        comp = jfn.lower(*fargs).compile()
+        c = comp.cost_analysis()
+        if isinstance(c, list):
+            c = c[0]
+        ca = c or {}
+    except Exception:
+        return {}
+    row = {
+        "bytes_accessed": ca.get("bytes accessed", 0),
+        "flops": ca.get("flops", 0),
+    }
+    if "optimal_seconds" in ca:
+        row["optimal_seconds"] = ca["optimal_seconds"]
+    return row
+
+
+def run_latency(args) -> Dict[str, Any]:
+    import numpy as np
+
+    from kafkastreams_cep_tpu.engine import EngineConfig
+    from kafkastreams_cep_tpu.runtime.ingest import IngestPolicy
+    from kafkastreams_cep_tpu.runtime.processor import CEPProcessor, Record
+    from kafkastreams_cep_tpu.utils.latency import LatencyLedger, SLOTracker
+
+    K = args.k if isinstance(args.k, int) else int(args.k.split(",")[0])
+    T = args.t
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    ingest = (
+        IngestPolicy(grace_ms=args.grace_ms, reorder_depth=max(4 * K * T, 64))
+        if args.grace_ms > 0
+        else None
+    )
+    ledger = LatencyLedger(
+        slo=SLOTracker(threshold_s=args.slo_ms / 1e3)
+    )
+    proc = CEPProcessor(
+        _stock_pattern(), K, cfg, ingest=ingest, latency=ledger,
+        drain_interval=args.drain_interval,
+    )
+    rng = np.random.default_rng(args.seed)
+    tracing = False
+    if args.trace_dir:
+        import jax
+
+        try:
+            jax.profiler.start_trace(args.trace_dir)
+            tracing = True
+        except Exception as e:
+            _log(f"latency: trace capture unavailable ({e})")
+    matches = 0
+    try:
+        ts = 0
+        for _ in range(args.batches):
+            records = []
+            for i in range(K * T):
+                ts += int(rng.integers(1, 3))
+                records.append(Record(
+                    key=int(i % K),
+                    value={
+                        "price": int(rng.integers(90, 131)),
+                        "volume": int(rng.integers(600, 1101)),
+                    },
+                    timestamp=ts,
+                ))
+            matches += len(proc.process(records))
+        matches += len(proc.flush())
+    finally:
+        if tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+    snap = proc.metrics_snapshot(per_lane=False)
+    lat = snap.get("latency") or {}
+    segments = {
+        name: {
+            k: seg[k]
+            for k in ("count", "p50", "p95", "p99", "p999")
+            if k in seg
+        }
+        for name, seg in (lat.get("segments") or {}).items()
+    }
+    device_cost = {
+        "scan": _cost_analysis(proc.batch.scan, proc.state,
+                               _stock_events(K, T)),
+    }
+    for name, seg in segments.items():
+        _log(
+            f"latency[{name}]: n={seg.get('count', 0)} "
+            f"p50={seg.get('p50')} p99={seg.get('p99')}"
+        )
+    return {
+        "profile": "latency",
+        "k": K,
+        "t": T,
+        "batches": args.batches,
+        "drain_interval": args.drain_interval,
+        "grace_ms": args.grace_ms,
+        "matches": matches,
+        "segments": segments,
+        "slo": lat.get("slo"),
+        "exemplars": lat.get("exemplars"),
+        "device_cost": device_cost,
+        "trace_dir": args.trace_dir or None,
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -512,6 +721,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     common(sp, "256")
     sp.add_argument("--runs", type=int, default=16)
     sp.add_argument("--slab", type=int, default=32)
+    sp = sub.add_parser("latency")
+    common(sp, "64")
+    sp.add_argument("--batches", type=int, default=4)
+    sp.add_argument("--grace-ms", type=int, default=0,
+                    help="reorder grace (0 = no ingest guard)")
+    sp.add_argument("--drain-interval", type=int, default=1)
+    sp.add_argument("--slo-ms", type=float, default=1000.0,
+                    help="e2e SLO threshold for burn-rate tracking")
+    sp.add_argument("--trace-dir", default=None,
+                    help="capture a jax.profiler trace into this dir")
 
     args = p.parse_args(argv)
     # Normalize --k for single-int subcommands.
@@ -526,6 +745,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "phases": run_phases,
         "ablate": run_ablate,
         "selectivity": run_selectivity,
+        "latency": run_latency,
     }[args.cmd](args)
     print(json.dumps(out), flush=True)
     return 0

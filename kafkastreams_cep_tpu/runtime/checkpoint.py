@@ -163,6 +163,15 @@ def save_checkpoint(
             if processor._guard is not None
             else None
         ),
+        # Latency-ledger state (utils/latency.py): committed segment
+        # histograms plus in-flight deferred bundles — additive key
+        # (readers default to None when absent, so format_version stays
+        # put), same durability discipline as the guard state above.
+        "latency": (
+            processor.ledger.to_state()
+            if getattr(processor, "ledger", None) is not None
+            else None
+        ),
     }
     buf = io.BytesIO()
     np.savez(buf, **arrays)
@@ -329,6 +338,13 @@ def restore_processor(
         # dead-letter total; re-base it so a restore never reads the
         # whole history as one burst.
         proc._dlq_base = int(sum(proc._guard.reason_counts.values()))
+    if header.get("latency") is not None:
+        from kafkastreams_cep_tpu.utils.latency import LatencyLedger
+
+        # The clock is not durable (pickling a callable would be a lie
+        # across hosts): the restored ledger runs on wall clock; callers
+        # with a pinned clock re-inject it via ``proc.set_clock(...)``.
+        proc.ledger = LatencyLedger.from_state(header["latency"])
     logger.info(
         "restored processor from %s: %d keys assigned, offsets %s",
         path, len(proc._lane_of), proc._next_offset.tolist(),

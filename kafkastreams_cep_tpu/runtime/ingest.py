@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
+import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
 from kafkastreams_cep_tpu.utils.logging import get_logger
@@ -247,13 +248,24 @@ class IngestGuard:
     :meth:`release`.
     """
 
-    def __init__(self, policy: IngestPolicy):
+    def __init__(self, policy: IngestPolicy, clock=None):
         self.policy = policy
-        # Min-heap of (timestamp, admission seq, record): seq is unique,
-        # so comparison never reaches the record and equal-timestamp
-        # records pop in arrival order.
+        # Injectable wall clock for the latency ledger's admit stamps
+        # (tests pin a fake; stamps must survive process restarts, so the
+        # default is time.time, not perf_counter).
+        self._clock = clock if clock is not None else time.time
+        # Min-heap of (timestamp, admission seq, record, admit_stamp):
+        # seq is unique, so comparison never reaches the record and
+        # equal-timestamp records pop in arrival order.  The admit stamp
+        # is the host wall clock at push — it rides the heap entry (and
+        # therefore checkpoint state) so reorder-hold latency survives
+        # restore without loss.
         self._heap: List[tuple] = []
         self._evicted: List[tuple] = []  # depth-overflow force-releases
+        #: Admit stamps of the records the last release()/drain() emitted,
+        #: aligned with the returned list (None entries = stamp unknown,
+        #: e.g. entries restored from a pre-stamp checkpoint).
+        self.last_release_stamps: List[Optional[float]] = []
         self._seq = 0
         # Event-time bookkeeping (absolute ms): max timestamp admitted,
         # and the release frontier — the highest timestamp already handed
@@ -302,7 +314,7 @@ class IngestGuard:
         """Admit one validated record into the buffer (may force-release
         the earliest held record when the depth cap is hit)."""
         ts = int(record.timestamp)
-        heapq.heappush(self._heap, (ts, self._seq, record))
+        heapq.heappush(self._heap, (ts, self._seq, record, self._clock()))
         self._seq += 1
         self.admitted += 1
         self.max_seen = ts if self.max_seen is None else max(
@@ -361,7 +373,12 @@ class IngestGuard:
                 self.frontier, entries[-1][0]
             )
             self.released += len(entries)
-        return [rec for _, _, rec in entries]
+        # len(e) guard: entries restored from a pre-stamp (3-tuple)
+        # checkpoint have no admit stamp — their reorder hold reads 0.
+        self.last_release_stamps = [
+            e[3] if len(e) > 3 else None for e in entries
+        ]
+        return [e[2] for e in entries]
 
     # -- telemetry ----------------------------------------------------------
 
@@ -425,9 +442,15 @@ class IngestGuard:
     @classmethod
     def from_state(cls, state: Dict[str, Any]) -> "IngestGuard":
         guard = cls(IngestPolicy(**state["policy"]))
-        guard._heap = [tuple(e) for e in state["heap"]]
+        # Pre-stamp (3-tuple) checkpoint entries pad with a None admit
+        # stamp: restored holds read 0 rather than fabricating a stamp.
+        def _pad(e):
+            e = tuple(e)
+            return e if len(e) > 3 else e + (None,)
+
+        guard._heap = [_pad(e) for e in state["heap"]]
         heapq.heapify(guard._heap)
-        guard._evicted = [tuple(e) for e in state["evicted"]]
+        guard._evicted = [_pad(e) for e in state["evicted"]]
         guard._seq = int(state["seq"])
         guard.max_seen = state["max_seen"]
         guard.frontier = state["frontier"]

@@ -388,6 +388,11 @@ def migrate_processor(pattern, proc, new_config: EngineConfig, mesh=None):
     # exactly like the event mirror (the engine never saw the held
     # records, so widening cannot perturb them).
     new_proc._guard = proc._guard
+    # Latency ledger + clock: continuity by reference, like metrics —
+    # committed histograms and in-flight deferred bundles survive the
+    # rebuild (deferred handles moved with the engine state above).
+    new_proc.ledger = proc.ledger
+    new_proc._clock = proc._clock
     logger.info(
         "migrated processor %s -> %s",
         {f: getattr(old_config, f) for f in _SHAPE_DIMS},
@@ -460,6 +465,8 @@ def replan_processor(pattern, proc, profile):
     new_proc.flight = proc.flight
     new_proc._dlq_base = proc._dlq_base
     new_proc._guard = proc._guard
+    new_proc.ledger = proc.ledger  # continuity by reference, like metrics
+    new_proc._clock = proc._clock
     logger.info(
         "replanned processor: tier=%s lazy_order=%s",
         new_proc.batch.plan.tier,
@@ -659,6 +666,8 @@ def move_lanes(pattern, proc, perm=None, mesh=_KEEP_MESH):
     new_proc.flight = proc.flight
     new_proc._dlq_base = proc._dlq_base
     new_proc._guard = proc._guard
+    new_proc.ledger = proc.ledger  # continuity by reference, like metrics
+    new_proc._clock = proc._clock
     if new_proc._guard is not None:
         new_proc._guard.source_hw = {
             int(inv[l]): hw for l, hw in new_proc._guard.source_hw.items()

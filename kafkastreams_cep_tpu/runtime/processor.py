@@ -149,6 +149,8 @@ class CEPProcessor:
         ingest: Optional[IngestPolicy] = None,
         flight=None,
         profile=None,
+        clock=None,
+        latency=None,
     ):
         # ``profile``: an optional measured ``per_stage`` selectivity
         # snapshot (``stage_counters()`` of an attribution run) handed to
@@ -272,13 +274,44 @@ class CEPProcessor:
         # ``on_bad_record="raise"``), held until the watermark passes them,
         # and released to the engine in timestamp order with auto-assigned
         # engine offsets; source offsets drive replay dedup at admission.
-        self._guard = IngestGuard(ingest) if ingest is not None else None
+        # Injectable wall clock (tests pin a fake): every host-side stamp —
+        # the event-time-lag gauge and all latency-ledger boundaries —
+        # reads it.  Wall clock (time.time), not perf_counter: stamps must
+        # stay comparable across a checkpoint→restore process boundary.
+        self._clock = clock if clock is not None else time.time
+        # Latency-attribution ledger (utils/latency.py): ``True`` builds a
+        # fresh ledger on this processor's clock, an existing ledger is
+        # adopted as-is (supervisor restore / bank members sharing one),
+        # None/False disarms it — one ``None`` check per call site, zero
+        # device work either way.
+        if latency is True:
+            from kafkastreams_cep_tpu.utils.latency import LatencyLedger
+
+            self.ledger = LatencyLedger(clock=self._clock)
+        else:
+            self.ledger = latency or None
+        self._guard = (
+            IngestGuard(ingest, clock=self._clock)
+            if ingest is not None
+            else None
+        )
         # Flight recorder (runtime/flight.py): a bounded ring of per-batch
         # records (phase timings, counter deltas, occupancy) appended at
         # the end of every batch and dumped as JSONL on crash/escalation/
         # quarantine-burst — None costs one check per batch.
         self.flight = flight
         self._dlq_base = 0  # dead-letter total at last batch (burst detect)
+
+    def set_clock(self, clock) -> None:
+        """Re-inject the host clock everywhere it is read (processor
+        stamps, guard admit stamps, ledger commits).  Clocks are not
+        durable state — a restored processor runs on wall clock until the
+        caller pins one (tests do, for deterministic stamps)."""
+        self._clock = clock
+        if self._guard is not None:
+            self._guard._clock = clock
+        if self.ledger is not None:
+            self.ledger.clock = clock
 
     # -- key -> lane assignment (partition-assignment analog) ---------------
 
@@ -333,6 +366,9 @@ class CEPProcessor:
             self.trace, "batch", path="records", batch=self._batch_seq,
             records=len(records),
         ) as sp:
+            # Release stamp for the latency ledger: batch entry (the guard
+            # releases mid-pack; validation time counts as queue).
+            lat_t0 = self._clock() if self.ledger is not None else None
             with self._phase("pack"):
                 if self._guard is not None:
                     released = self._ingest(
@@ -351,7 +387,18 @@ class CEPProcessor:
                 return []
             events, rank_of, n_kept = packed
             sp["lanes"] = len(self._lane_of)
-            matches = self._dispatch(events, rank_of, n_kept)
+            lat = None
+            if self.ledger is not None:
+                lat = self.ledger.start_batch(
+                    f"{self.name}-{self._batch_seq}", n_kept,
+                    admit=(
+                        self._guard.last_release_stamps
+                        if self._guard is not None
+                        else None
+                    ),
+                    release=lat_t0,
+                )
+            matches = self._dispatch(events, rank_of, n_kept, lat)
             sp["matches"] = len(matches)
             return matches
 
@@ -472,6 +519,7 @@ class CEPProcessor:
         :meth:`flush` afterwards for pipelined / lazy processors."""
         if self._guard is None:
             return []
+        lat_t0 = self._clock() if self.ledger is not None else None
         released = self._guard.drain()
         if not released:
             return []
@@ -488,7 +536,13 @@ class CEPProcessor:
                 packed = self._pack_records(released)
             if packed is None:
                 return []
-            matches = self._dispatch(*packed)
+            lat = None
+            if self.ledger is not None:
+                lat = self.ledger.start_batch(
+                    f"{self.name}-{self._batch_seq}", packed[2],
+                    admit=self._guard.last_release_stamps, release=lat_t0,
+                )
+            matches = self._dispatch(*packed, lat)
             sp["matches"] = len(matches)
             return matches
 
@@ -699,6 +753,7 @@ class CEPProcessor:
         with maybe_span(
             self.trace, "batch", path="columns", batch=self._batch_seq,
         ) as sp:
+            lat_t0 = self._clock() if self.ledger is not None else None
             with self._phase("pack"):
                 packed = self._pack_columns(keys, values, timestamps)
             if packed is None:
@@ -706,7 +761,12 @@ class CEPProcessor:
             events, rank_of, n = packed
             sp["records"] = n
             sp["lanes"] = len(self._lane_of)
-            matches = self._dispatch(events, rank_of, n)
+            lat = None
+            if self.ledger is not None:
+                lat = self.ledger.start_batch(
+                    f"{self.name}-{self._batch_seq}", n, release=lat_t0,
+                )
+            matches = self._dispatch(events, rank_of, n, lat)
             sp["matches"] = len(matches)
             return matches
 
@@ -885,7 +945,7 @@ class CEPProcessor:
         )
         return events, rank_of, n
 
-    def _dispatch(self, events, rank_of, n_records):
+    def _dispatch(self, events, rank_of, n_records, lat=None):
         # Fault-injection sites (utils/failpoints.py; no-ops unless a test
         # armed them): ``device.dispatch`` fails before the scan — state
         # untouched; ``device.result`` fails after ``self.state`` advanced
@@ -901,6 +961,8 @@ class CEPProcessor:
             events = self.batch.shard_events(events)
 
         base = self._step_base
+        if lat is not None:
+            lat.dispatch = self._clock()
         with self._phase("dispatch"):
             # Enqueue only: the scan (and any due sweep) dispatch async;
             # the wait is attributed to the device phase below.
@@ -929,6 +991,13 @@ class CEPProcessor:
                 jax.block_until_ready(
                     out.count if drain_out is None else drain_out.count
                 )
+        if lat is not None:
+            # Device-completion stamp: rides the existing gates transfer —
+            # no extra device_get.  Serial mode just blocked, so this is
+            # real completion; pipelined mode observes the enqueue point
+            # (the wait lands in the next call's decode, and so does the
+            # stamp's tail — host-observed by design).
+            lat.complete = self._clock()
         _failpoint("device.result")
         gc_due = self.gc_events and (
             (self.metrics.batches + 1) % self.gc_events_interval == 0
@@ -938,22 +1007,52 @@ class CEPProcessor:
         with self._phase("decode"):
             if self.pipeline:
                 prev, self._pending = (
-                    self._pending, (out, rank_of, drain_out, base),
+                    self._pending, (out, rank_of, drain_out, base, lat),
                 )
-                matches = self._decode(*prev) if prev is not None else []
+                matches = self._decode(*prev[:4]) if prev is not None else []
+                if prev is not None:
+                    self._lat_finish(
+                        prev[4], (not self.lazy) or prev[2] is not None
+                    )
                 if gc_due:
                     # The GC liveness pull must not prune events the
                     # still-pending decode references: drain first.
                     pend, self._pending = self._pending, None
-                    matches += self._decode(*pend)
+                    matches += self._decode(*pend[:4])
+                    self._lat_finish(
+                        pend[4], (not self.lazy) or pend[2] is not None
+                    )
             else:
                 matches = self._decode(out, rank_of, drain_out, base)
+                self._lat_finish(
+                    lat, (not self.lazy) or drain_out is not None
+                )
         if gc_due:
             with self._phase("gc"):
                 self._gc_events()
         self.metrics.matches_out += len(matches)
         self._flight_tick()
         return matches
+
+    def _lat_finish(self, lat, emitted: bool) -> None:
+        """Commit or defer one batch's latency bundle at its decode.
+
+        ``emitted`` means the batch's matches just left the device (eager
+        decode, or this batch's drain carried its handles): the bundle —
+        plus any parked earlier bundles whose handles rode the same drain
+        — commits at one emit stamp.  Otherwise (lazy, drain not due) the
+        bundle parks until the drain that emits it; a bundle that never
+        commits because its batch failed dies with the rollback and is
+        re-observed on replay — exactly-once counts, honest wall clock.
+        """
+        if lat is None or self.ledger is None:
+            return
+        if emitted:
+            emit = self._clock()
+            self.ledger.commit_deferred(emit)
+            self.ledger.commit(lat, emit)
+        else:
+            self.ledger.defer(lat)
 
     def _flight_tick(self) -> None:
         """Record this batch in the flight ring (runtime/flight.py) and
@@ -981,7 +1080,8 @@ class CEPProcessor:
         if self._pending is not None:
             pend, self._pending = self._pending, None
             with self._phase("decode"):
-                matches = self._decode(*pend)
+                matches = self._decode(*pend[:4])
+            self._lat_finish(pend[4], (not self.lazy) or pend[2] is not None)
         if self.lazy:
             with self._phase("drain"):
                 self.state, dout = self.batch.drain(self.state)
@@ -989,6 +1089,9 @@ class CEPProcessor:
                 # No rank_of: everything pending predates "now", so the
                 # order key degrades to (completion step, lane, run row).
                 matches += self._decode_drained(dout, None, self._step_base)
+            if self.ledger is not None:
+                # This drain emitted every parked batch's matches.
+                self.ledger.commit_deferred(self._clock())
         self.metrics.matches_out += len(matches)
         return matches
 
@@ -1280,11 +1383,18 @@ class CEPProcessor:
         tier = self.tier_counters()
         snap.update(tier)
         snap["watermark"] = self._watermark
+        # Injectable clock (not inline time.time): deterministic under a
+        # pinned test clock, and consistent with every latency stamp.
         snap["event_time_lag_ms"] = (
-            int(time.time() * 1000) - self._watermark
+            int(self._clock() * 1000) - self._watermark
             if self._watermark is not None
             else None
         )
+        if self.ledger is not None:
+            # Latency-attribution ledger (utils/latency.py): segment/stall/
+            # per-query histograms, exemplars, and the SLO burn gauge —
+            # rendered as cep_latency_seconds{segment=} etc.
+            snap["latency"] = self.ledger.snapshot()
         if self._guard is not None:
             # Guard telemetry: the three loss counters (all-zero ⇒
             # loss-free), hold depth/age gauges, and per-reason

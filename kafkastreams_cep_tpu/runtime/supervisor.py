@@ -379,6 +379,9 @@ class Supervisor:
         self._shard_lat: dict = {}
         self._lag_streak: dict = {}
         self._lagging: set = set()
+        # SLO burn rising-edge latch (see _slo_tick): one flight dump per
+        # excursion over burn 1.0, not one per batch while burning.
+        self._slo_burning = False
         # Rebalance hysteresis: per-lane hop baseline for the windowed
         # delta, consecutive tripping boundaries, boundaries since the
         # last move.
@@ -790,6 +793,7 @@ class Supervisor:
                 logger.exception("checkpoint failed; journal retained")
         if self._policy is not None:
             self._maybe_escalate_ingest()
+        self._slo_tick(corr)
         if self._unclaimed:
             # A failed snapshot above still flushed the pipeline; those
             # matches belong to the caller either way.
@@ -844,9 +848,14 @@ class Supervisor:
                     mesh=self._proc_kwargs.get("mesh"),
                 )
             # Checkpoints carry no telemetry wiring: reattach the trace
-            # sink so post-recovery batches keep emitting spans.
+            # sink so post-recovery batches keep emitting spans.  The
+            # clock is wiring too (checkpoints carry no callables) — a
+            # pinned test clock must keep ticking the restored ledger.
             self.processor.trace = self.trace
             self.processor.flight = self.flight
+            clock = self._proc_kwargs.get("clock")
+            if clock is not None:
+                self.processor.set_clock(clock)
         else:
             num_lanes = self.processor.num_lanes
             config = self.processor.batch.matcher.config
@@ -870,6 +879,40 @@ class Supervisor:
         self._replan_streak = 0
         return replayed
 
+    def _observe_stall(
+        self, cause: str, seconds: float, corr: Optional[str]
+    ) -> None:
+        """Attribute one lifecycle stall (recover/evacuate/replan wall
+        time) to the latency ledger, tagged with the ``corr`` id of the
+        batch the rollback was handling — a stall exemplar then resolves
+        to the same trace span as the recovery span itself.  The live
+        (post-rebuild) processor's ledger takes the observation: the
+        pre-failure ledger rolled back with the state it described."""
+        ledger = getattr(self.processor, "ledger", None)
+        if ledger is not None:
+            ledger.observe_stall(cause, seconds, corr=corr)
+
+    def _slo_tick(self, corr: str) -> None:
+        """Rising-edge SLO-burn annotation: when the ledger's burn rate
+        first crosses 1.0 (burning faster than the error budget), note the
+        rate in the flight ring and dump it — the post-mortem then carries
+        the batches that spent the budget.  Re-arms when burn falls back
+        under 1.0."""
+        ledger = getattr(self.processor, "ledger", None)
+        if ledger is None or ledger.slo is None:
+            return
+        burn = ledger.slo.burn_rate()
+        if burn > 1.0 and not self._slo_burning:
+            self._slo_burning = True
+            logger.warning(
+                "SLO burn rate %.3f exceeds budget (corr=%s)", burn, corr
+            )
+            if self.flight is not None:
+                self.flight.note(slo_burn=round(burn, 3))
+                self.flight.dump("slo_burn", corr=corr)
+        elif burn <= 1.0 and self._slo_burning:
+            self._slo_burning = False
+
     def _recover(self, corr: Optional[str] = None) -> None:
         # ``corr`` correlates the recovery span with the batch span whose
         # failure provoked it (None when driven outside process(), e.g.
@@ -880,12 +923,14 @@ class Supervisor:
             # batch's context (the restore rebuilds the processor, and
             # replayed batches would overwrite the interesting tail).
             self.flight.dump("recover", corr=corr)
+        t0 = time.perf_counter()
         with maybe_span(
             self.trace, "recover", corr=corr, seq=self._seq,
         ) as sp, timed_histogram(self.telemetry, "phase.recover"):
             replayed = self._restore_tail()
             sp["replayed_records"] = replayed
             sp["from_checkpoint"] = self._has_checkpoint
+        self._observe_stall("recover", time.perf_counter() - t0, corr)
         self.recoveries += 1
         # Counters reverted with the state; re-snapshot the escalation
         # baseline BEFORE the retry re-runs the failing batch, or its
@@ -947,6 +992,7 @@ class Supervisor:
                 evacuation=self.evacuations + 1, dead_shards=dead
             )
             self.flight.dump("evacuate", corr=corr)
+        t0 = time.perf_counter()
         with maybe_span(
             self.trace, "evacuate", corr=corr, seq=self._seq,
             dead_shards=dead, survivors=int(new_mesh.devices.size),
@@ -964,6 +1010,7 @@ class Supervisor:
                     "the next good snapshot re-places lanes itself "
                     "(restore_processor repartitions on mesh-size change)"
                 )
+        self._observe_stall("evacuate", time.perf_counter() - t0, corr)
         self.evacuations += 1
         # Shard indices are renumbered by the shrink: every piece of
         # straggler and skew bookkeeping keyed by the old numbering is
@@ -1231,6 +1278,7 @@ class Supervisor:
             or self._boundaries_since_replan <= policy.cooldown
         ):
             return
+        t0 = time.perf_counter()
         with maybe_span(
             self.trace, "replan", corr=corr, seq=self._seq,
             drifted=[
@@ -1272,6 +1320,7 @@ class Supervisor:
                 if ev >= policy.min_evals
             }
             self._sel_prev = None
+        self._observe_stall("replan", time.perf_counter() - t0, corr)
         logger.warning(
             "adaptive replan #%d: selectivity drift %s (plan -> window); "
             "plan re-derived from the measured profile",

@@ -290,6 +290,8 @@ class TenantCEP:
         reorder: bool = True,
         quotas: Optional[Dict] = None,
         admission: Optional[AdmissionPolicy] = None,
+        clock=None,
+        latency=None,
     ):
         if not patterns:
             raise ValueError("a tenant bank needs at least one pattern")
@@ -314,6 +316,22 @@ class TenantCEP:
         ]
         self._value_proto: Any = None
         self.batches = 0
+        # Injectable clock + latency ledger (utils/latency.py): the tenant
+        # path has no reorder buffer, so segments degrade gracefully —
+        # reorder_hold is 0, queue is the pack, device is the bank scan +
+        # result pull, drain_defer the host emit loop.  Per-query e2e
+        # lands in ``observe_query`` (one ``query=`` label per tenant).
+        self._clock = clock if clock is not None else time.time
+        if latency is True:
+            from kafkastreams_cep_tpu.utils.latency import LatencyLedger
+
+            self.ledger = LatencyLedger(clock=self._clock)
+        else:
+            self.ledger = latency or None
+        # Event-time watermark (max packed record timestamp): feeds the
+        # same watermark / event-time-lag gauges CEPProcessor surfaces —
+        # the tenant wrapper historically omitted them.
+        self._watermark: Optional[int] = None
 
     # -- routing --------------------------------------------------------------
 
@@ -372,8 +390,15 @@ class TenantCEP:
     def _process_admitted(
         self, records: List[Record]
     ) -> List[Tuple[str, Hashable, Sequence]]:
+        lat = None
+        if self.ledger is not None:
+            lat = self.ledger.start_batch(
+                f"{self.topic}-{self.batches + 1}", len(records),
+            )
         events, rank_of = self._pack(records)
         _failpoint("device.dispatch")
+        if lat is not None:
+            lat.dispatch = self._clock()
         self.state, out = self.batch.scan(self.state, events)
         _failpoint("device.result")
         self.batches += 1
@@ -381,6 +406,8 @@ class TenantCEP:
         count = np.asarray(jax.device_get(out.count))  # [N, K, T, R]
         stage = np.asarray(jax.device_get(out.stage))
         off = np.asarray(jax.device_get(out.off))
+        if lat is not None:
+            lat.complete = self._clock()  # result pull done = device done
         for q, qname in enumerate(self.query_names):
             names = self.batch.names_of(q)
             ks, ts, rs = np.nonzero(count[q])
@@ -397,6 +424,14 @@ class TenantCEP:
                         self._events[k][int(off[q, k, ts[i], rs[i], w])],
                     )
                 matches.append((qname, self._key_of[k], seq))
+        if lat is not None:
+            emit = self._clock()
+            self.ledger.commit(lat, emit)
+            # Per-query e2e: one observation per emitted match under the
+            # query's label (the bank's per-tenant latency attribution).
+            e2e = max(emit - lat.release, 0.0)
+            for qname, _k, _s in matches:
+                self.ledger.observe_query(qname, e2e)
         return matches
 
     def _pack(self, records: List[Record]):
@@ -442,6 +477,8 @@ class TenantCEP:
                 self._next_offset[k] = o + 1
                 key_arr[k, t] = self._key_code(rec.key, k)
                 ts_arr[k, t] = int(rec.timestamp)
+                if self._watermark is None or rec.timestamp > self._watermark:
+                    self._watermark = int(rec.timestamp)
                 off_arr[k, t] = o
                 valid[k, t] = True
                 rank_of[k, t] = rank
@@ -547,6 +584,17 @@ class TenantCEP:
 
     def metrics_snapshot(self) -> Dict[str, object]:
         out = self.batch.metrics_snapshot(self.state)
+        # Watermark / event-time-lag gauges — the same ``records-lag``
+        # analog CEPProcessor surfaces, through the same injectable clock
+        # (the tenant and meshed wrappers historically omitted it).
+        out["watermark"] = self._watermark
+        out["event_time_lag_ms"] = (
+            int(self._clock() * 1000) - self._watermark
+            if self._watermark is not None
+            else None
+        )
+        if self.ledger is not None:
+            out["latency"] = self.ledger.snapshot()
         if self.admission is not None:
             ledger = self.admission.ledger()
             for name in ("offered", "admitted", "shed",
@@ -602,6 +650,12 @@ def save_tenant_checkpoint(
         # deterministic ledger/bucket state rides along.
         "isolation": tenant.batch.iso_state(),
         "quarantine_reasons": dict(tenant.quarantine_reasons),
+        # Watermark + latency-ledger state (additive — readers default
+        # when absent): same durability discipline as the processor path.
+        "watermark": tenant._watermark,
+        "latency": (
+            tenant.ledger.to_state() if tenant.ledger is not None else None
+        ),
         "admission": (
             tenant.admission.to_state()
             if tenant.admission is not None
@@ -693,6 +747,14 @@ def restore_tenant(
     tenant._events = [dict(d) for d in header["events"]]
     tenant._value_proto = header["value_proto"]
     tenant.batches = int(header["batches"])
+    tenant._watermark = header.get("watermark")
+    if header.get("latency") is not None:
+        from kafkastreams_cep_tpu.utils.latency import LatencyLedger
+
+        # Clock stays as constructed (clocks are wiring, not state).
+        tenant.ledger = LatencyLedger.from_state(
+            header["latency"], clock=tenant._clock
+        )
     iso = header.get("isolation")
     if iso is not None:
         tenant.batch.load_iso_state(iso)

@@ -135,6 +135,25 @@ class Histogram:
                 lo = mid + 1
         self.counts[lo] += 1
 
+    def observe_many(self, v: float, n: int) -> None:
+        """``n`` observations of the same value ``v`` — one bisect, not
+        ``n``.  The latency ledger's shared-stamp segments (every record in
+        a micro-batch dispatches/completes/emits at one host instant) make
+        this the hot path for per-record attribution at batch cadence."""
+        if n <= 0:
+            return
+        v = float(v)
+        self.total += n
+        self.sum += v * n
+        lo, hi = 0, len(self.edges)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if v <= self.edges[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self.counts[lo] += n
+
     def merge(self, other: "Histogram") -> "Histogram":
         """A NEW histogram holding both operands' observations.  Requires
         identical edges (the determinism contract that makes merging across
@@ -552,6 +571,44 @@ def _is_hist_snap(v) -> bool:
     return isinstance(v, dict) and {"count", "sum", "buckets"} <= set(v)
 
 
+#: Curated HELP text by unprefixed metric family name.  Families not
+#: listed fall back to a deterministic pointer at the README reference —
+#: the metrics-guard test (tests/test_metrics_guard.py) only requires that
+#: *every* emitted family carries HELP/TYPE, which the fallback guarantees.
+METRIC_HELP: Dict[str, str] = {
+    "phase_seconds": (
+        "Host wall time per processing phase (pack/dispatch/device/decode/"
+        "gc and supervisor lifecycle verbs)"
+    ),
+    "latency_seconds": (
+        "Per-record ingest-to-emit latency by lifecycle segment "
+        "(reorder_hold/queue/device/drain_defer/e2e_total)"
+    ),
+    "stall_seconds": (
+        "Supervisor stall wall time (recover/evacuate/replan) attributed "
+        "to the batch it rolled back"
+    ),
+    "latency_query_seconds": (
+        "Per-query end-to-end latency (multi-tenant bank)"
+    ),
+    "slo_burn": (
+        "SLO burn rate: windowed over-threshold record fraction divided by "
+        "the error budget (1 - target); >1 burns faster than budget"
+    ),
+    "slo_target": "Declared SLO target percentile (fraction in (0,1))",
+    "slo_threshold_seconds": "Declared SLO end-to-end latency threshold",
+    "dead_letters_total": "Ingestion-guard quarantined records by reason",
+    "event_time_lag_ms": (
+        "Milliseconds between the host clock and the event-time watermark"
+    ),
+    "watermark": (
+        "Event-time watermark: max packed record timestamp (ms since epoch)"
+    ),
+    "key_hops_total": "Walk-kernel hop work summed over all keys",
+    "key_hops": "Walk-kernel hop work for the top-K heaviest keys",
+}
+
+
 def render_prometheus(
     snapshot: Dict[str, Any], prefix: str = "cep"
 ) -> str:
@@ -563,19 +620,42 @@ def render_prometheus(
     ``per_lane``  -> ``{lane="i"}``, ``per_pattern`` -> ``{pattern="name"}``,
     ``per_query`` -> ``{query="name"}`` (the multi-tenant bank),
     ``phases``    -> ``<prefix>_phase_seconds{phase="name"}`` histograms,
+    ``latency``   -> ``<prefix>_latency_seconds{segment="name"}`` histograms
+    plus stall/per-query histograms and the ``<prefix>_slo_burn`` gauge
+    (the latency-attribution ledger, utils/latency.py),
     ``dead_letters`` -> ``<prefix>_dead_letters_total{reason="late"}``,
     ``hbm``       -> ``<prefix>_hbm_<stat>`` gauges.  Histogram snapshots
     render as cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``.
     ``None`` values are skipped (absent, not zero).
+
+    Every emitted family is preceded (at first occurrence) by ``# HELP`` /
+    ``# TYPE`` metadata: curated text from :data:`METRIC_HELP` where
+    available, a deterministic README pointer otherwise; type is
+    ``histogram`` for histogram families, ``counter`` for ``_total``
+    names, ``gauge`` for the rest.
     """
     lines: List[str] = []
+    seen_meta: set = set()
+
+    def meta(name: str, mtype: str) -> None:
+        if name in seen_meta:
+            return
+        seen_meta.add(name)
+        base = name[len(prefix) + 1:] if name.startswith(f"{prefix}_") else name
+        text = METRIC_HELP.get(
+            base, "runtime metric (see README metrics reference)"
+        )
+        lines.append(f"# HELP {name} {text}")
+        lines.append(f"# TYPE {name} {mtype}")
 
     def scalar(name: str, v, labels: str = "") -> None:
         if v is None or isinstance(v, str):
             return
+        meta(name, "counter" if name.endswith("_total") else "gauge")
         lines.append(f"{name}{labels} {_fmt(v)}")
 
     def hist(name: str, snap: Dict[str, Any], labels: Dict[str, str]) -> None:
+        meta(name, "histogram")
         base = ",".join(f'{k}="{v}"' for k, v in labels.items())
         pre = f"{base}," if base else ""
         for edge, cum in snap["buckets"]:
@@ -686,6 +766,45 @@ def render_prometheus(
                             v,
                             f'{{query="{qname}"}}',
                         )
+        elif key == "latency" and isinstance(val, dict):
+            # Latency-attribution ledger (utils/latency.py): one histogram
+            # per lifecycle segment, per-cause stall histograms, per-query
+            # e2e histograms, and the SLO burn gauge.  Exemplars stay in
+            # the JSON snapshot (text exposition has no exemplar syntax).
+            segs = val.get("segments", {})
+            for seg in sorted(segs):
+                if _is_hist_snap(segs[seg]):
+                    hist(
+                        f"{prefix}_latency_seconds", segs[seg],
+                        {"segment": seg},
+                    )
+            stalls = val.get("stalls", {})
+            for cause in sorted(stalls):
+                if _is_hist_snap(stalls[cause]):
+                    hist(
+                        f"{prefix}_stall_seconds", stalls[cause],
+                        {"cause": cause},
+                    )
+            pq = val.get("per_query", {})
+            for qname in sorted(pq):
+                if _is_hist_snap(pq[qname]):
+                    hist(
+                        f"{prefix}_latency_query_seconds", pq[qname],
+                        {"query": qname},
+                    )
+            slo = val.get("slo")
+            if isinstance(slo, dict):
+                scalar(f"{prefix}_slo_burn", slo.get("burn_rate"))
+                scalar(f"{prefix}_slo_target", slo.get("target"))
+                scalar(
+                    f"{prefix}_slo_threshold_seconds", slo.get("threshold_s")
+                )
+            scalar(f"{prefix}_latency_batches_total", val.get("batches"))
+            scalar(f"{prefix}_latency_records_total", val.get("records"))
+            scalar(
+                f"{prefix}_latency_deferred_batches",
+                val.get("deferred_batches"),
+            )
         elif key == "hbm" and isinstance(val, dict):
             for stat in sorted(val):
                 scalar(f"{prefix}_hbm_{_sanitize(stat)}", val[stat])

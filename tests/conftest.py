@@ -53,6 +53,8 @@ def pytest_collection_modifyitems(config, items):
     """
     def _age(it):
         nid = it.nodeid
+        if "test_latency" in nid or "test_metrics_guard" in nid:
+            return 5  # PR 18: latency attribution
         if "test_tenant_isolation" in nid:
             return 4  # PR 11: per-tenant isolation
         if "test_multitenant" in nid:

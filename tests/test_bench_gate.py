@@ -217,3 +217,46 @@ def test_gate_guards_tenant_iso_flags():
             bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r08.json"))
         ) or {}
     )
+
+
+def test_gate_guards_latency_flags_and_p99_ceiling():
+    """From BENCH_r10 on, the nested ``latency`` block flattens into the
+    guarded ``latency_*`` flags (ledger on/off match+counter parity,
+    within-config cadence/grace scheduling parity) and the
+    ``latency_e2e_p99_s`` lower-is-better ceiling: observability may
+    never change what the engine computes, and the end-to-end p99 may
+    not silently blow past the trajectory's best (ISSUE 18 satellite)."""
+    r10 = bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r10.json"))
+    m = bench_gate.extract_metrics(r10)
+    assert m["latency_parity"] is True
+    assert m["latency_ab_parity"] is True
+    assert m["latency_e2e_p99_s"] > 0
+    for key, metric in (
+        ("parity", "latency_parity"),
+        ("ab_match_parity", "latency_ab_parity"),
+    ):
+        bad = json.loads(json.dumps(r10))
+        bad["parsed"]["latency"][key] = False
+        ok, report = bench_gate.gate(bad, [r10])
+        assert not ok
+        assert any(
+            c["metric"] == metric and not c["ok"]
+            for c in report["checks"]
+        )
+    slow = json.loads(json.dumps(r10))
+    # The ceiling's latency-specific tolerance is wide (tail latency is
+    # log-bucket quantized); 5x p99 must still trip it.
+    slow["parsed"]["latency"]["e2e_p99_s"] *= 5
+    ok, report = bench_gate.gate(slow, [r10])
+    assert not ok
+    assert any(
+        c["metric"] == "latency_e2e_p99_s" and not c["ok"]
+        for c in report["checks"]
+    )
+    # Rounds predating the latency block stay unguarded on these
+    # metrics, so the historical trajectory replays clean.
+    assert "latency_parity" not in (
+        bench_gate.extract_metrics(
+            bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r09.json"))
+        ) or {}
+    )

@@ -1,0 +1,97 @@
+"""Tier-1 guard: the metrics surface cannot drift undocumented.
+
+Modeled on ``test_failpoint_guard.py``: a metric that ships without
+operator-facing docs is dead weight on the exact path that matters (the
+3am dashboard).  Two invariants, both driven from one *fat* supervisor
+snapshot (guard + tiering + attribution + latency ledger + a recovery):
+
+1. Every top-level ``metrics_snapshot()`` key appears in the README
+   metrics reference table (between the ``metrics-reference`` markers).
+2. Every family ``render_prometheus`` emits carries ``# HELP`` and
+   ``# TYPE`` metadata before its first sample.
+"""
+
+import dataclasses
+import pathlib
+import re
+
+import engine_scenarios as sc
+from kafkastreams_cep_tpu.runtime import Record, Supervisor
+from kafkastreams_cep_tpu.runtime.ingest import IngestPolicy
+from kafkastreams_cep_tpu.utils import failpoints as fp
+from kafkastreams_cep_tpu.utils.latency import LatencyLedger, SLOTracker
+from kafkastreams_cep_tpu.utils.telemetry import render_prometheus
+
+README = pathlib.Path(__file__).parent.parent / "README.md"
+
+
+def _fat_snapshot(tmp_path):
+    """One snapshot exercising every producer: ingest guard, tiered plan,
+    stage attribution, latency ledger with SLO, and a recovery."""
+    cfg = dataclasses.replace(
+        sc.default_config(), tiering=True, stage_attribution=True
+    )
+    sup = Supervisor(
+        sc.strict3(), 1, cfg,
+        checkpoint_path=str(tmp_path / "g.ckpt"), checkpoint_every=2,
+        gc_interval=1, ingest=IngestPolicy(grace_ms=0),
+        latency=LatencyLedger(slo=SLOTracker(threshold_s=1.0)),
+    )
+    vals = [sc.A, sc.B, sc.C, sc.X, sc.A, sc.B, sc.C, sc.X]
+    with fp.FAILPOINTS.session({"device.result": [2]}):
+        for i, v in enumerate(vals):
+            sup.process([Record("k", v, 1000 + i, offset=i)])
+    assert sup.recoveries == 1
+    return sup.metrics_snapshot()
+
+
+def _reference_table() -> str:
+    text = README.read_text()
+    m = re.search(
+        r"<!-- metrics-reference-start -->(.*?)"
+        r"<!-- metrics-reference-end -->",
+        text, re.S,
+    )
+    assert m, "README.md lost its metrics-reference markers"
+    return m.group(1)
+
+
+def test_every_snapshot_key_is_documented_in_readme(tmp_path):
+    table = _reference_table()
+    snap = _fat_snapshot(tmp_path)
+    undocumented = [
+        key for key in snap if f"`{key}`" not in table
+    ]
+    assert not undocumented, (
+        f"metrics_snapshot() keys {sorted(undocumented)} are not in the "
+        "README metrics reference table — document each new metric "
+        "(README.md, between the metrics-reference markers) before "
+        "landing it"
+    )
+
+
+def test_every_prometheus_family_has_help_and_type(tmp_path):
+    txt = render_prometheus(_fat_snapshot(tmp_path))
+    helped = set()
+    typed = set()
+    missing = []
+    for line in txt.splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+        elif line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+        elif line:
+            name = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)", line).group(1)
+            family = re.sub(r"_(bucket|sum|count)$", "", name)
+            if not (
+                {name, family} & helped and {name, family} & typed
+            ):
+                missing.append(line)
+    assert not missing, (
+        "Prometheus samples emitted without # HELP/# TYPE metadata "
+        f"(first few): {missing[:5]}"
+    )
+    # The latency families are present in the fat snapshot's rendering.
+    for family in ("cep_latency_seconds", "cep_slo_burn",
+                   "cep_phase_seconds"):
+        assert family in helped and family in typed

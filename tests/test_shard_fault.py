@@ -425,6 +425,42 @@ def test_supervisor_shard_probe_routes_generic_error_to_evacuation(tmp_path):
     assert _canon(got) == _canon(want)
 
 
+def test_evacuation_span_and_stall_exemplar_carry_batch_correlation(tmp_path):
+    """ISSUE 18 satellite: the evacuation trace span AND the latency
+    ledger's ``stall.evacuate`` exemplar carry the correlation id of the
+    batch the evacuation rolled back.  Evacuation rebuilds the processor
+    from checkpoint + journal replay, so the ledger survives through its
+    durable state (the checkpoint header), not by reference — committed
+    observations from before the fault must still be present after."""
+    from kafkastreams_cep_tpu.utils.telemetry import InMemoryTraceSink
+
+    mesh = _mesh2()
+    batches = [_stream(KEYS4, 8, seed=90 + i, start=2 * i)
+               for i in range(2)]
+    sink = InMemoryTraceSink()
+    sup = _meshed_supervisor(tmp_path, mesh, trace_sink=sink, latency=True)
+    sup.process(batches[0])
+    with fp.FAILPOINTS.session(
+        {"shard.dispatch": [0]},
+        exc=lambda: ShardLost("injected device loss", shard=1),
+    ):
+        sup.process(batches[1])
+    assert sup.evacuations == 1
+    span = sink.spans("evacuate")[0]
+    corr = span["corr"]
+    twins = [
+        s for s in sink.spans("supervisor.batch") if s["corr"] == corr
+    ]
+    assert len(twins) == 1  # resolves to exactly one real batch span
+    ex = sup.processor.ledger.exemplars["stall.evacuate"]
+    assert ex["corr"] == corr and ex["seconds"] > 0
+    snap = sup.metrics_snapshot(per_lane=False)
+    assert snap["latency"]["stalls"]["evacuate"]["count"] == 1
+    # Batches committed before AND after the evacuation land in one
+    # uninterrupted ledger.
+    assert snap["latency"]["batches"] >= 2
+
+
 def test_supervisor_straggler_declaration_and_evacuation(tmp_path):
     """Latency watermarks breaching factor x peer-median for
     ``straggler_streak`` observations declare the shard; the next batch

@@ -132,11 +132,17 @@ def test_prometheus_rendering_golden():
     reg.histogram("lat", (0.1, 1.0)).observe(5.0)
     got = render_prometheus(reg.snapshot(), prefix="cep")
     assert got == (
+        "# HELP cep_lag_ms runtime metric (see README metrics reference)\n"
+        "# TYPE cep_lag_ms gauge\n"
         "cep_lag_ms 7\n"
+        "# HELP cep_lat runtime metric (see README metrics reference)\n"
+        "# TYPE cep_lat histogram\n"
         'cep_lat_bucket{le="0.1"} 1\n'
         'cep_lat_bucket{le="+Inf"} 2\n'
         "cep_lat_sum 5.05\n"
         "cep_lat_count 2\n"
+        "# HELP cep_records_in runtime metric (see README metrics reference)\n"
+        "# TYPE cep_records_in gauge\n"
         "cep_records_in 12\n"
     )
 
@@ -251,6 +257,9 @@ TIMING_KEYS = (
     "device_seconds", "decode_seconds", "pack_seconds", "dispatch_seconds",
     "gc_seconds", "events_per_second_device", "event_time_lag_ms", "hbm",
     "phases",
+    # Latency-ledger segment values are wall clock; observation COUNTS are
+    # deterministic and asserted separately (tests/test_latency.py).
+    "latency",
     # Process-global LRU warmth: the second identical run hits programs
     # the first one traced, so hits/misses are order-dependent by design.
     "trace_cache",
@@ -375,6 +384,54 @@ def test_escalation_span_carries_batch_correlation(tmp_path):
     assert snap["phases"]["escalate"]["count"] == sup.escalations
 
 
+def test_replan_span_and_stall_exemplar_carry_batch_correlation(tmp_path):
+    """ISSUE 18 satellite: an adaptive replan's trace span AND the latency
+    ledger's ``stall.replan`` exemplar both carry the correlation id of
+    the batch boundary that triggered the swap — and the ledger itself
+    survives the ``replan_processor`` rebuild."""
+    import dataclasses
+
+    from kafkastreams_cep_tpu.runtime.supervisor import AdaptPolicy
+
+    cfg = dataclasses.replace(
+        sc.default_config(), tiering=True, stage_attribution=True
+    )
+    sink = InMemoryTraceSink()
+    sup = Supervisor(
+        sc.strict3(), 1, cfg,
+        checkpoint_path=str(tmp_path / "r.ckpt"), checkpoint_every=1,
+        gc_interval=0, trace_sink=sink, latency=True,
+        adapt_policy=AdaptPolicy(
+            drift_threshold=0.05, min_evals=1, replan_streak=1, cooldown=0
+        ),
+    )
+    ledger_before = sup.processor.ledger
+    # Boundary 1 pins the selectivity baseline, boundary 2 opens the
+    # window, boundary 3's flipped stream drifts past the threshold.
+    streams = [[sc.A, sc.B, sc.C], [sc.A, sc.B, sc.C], [sc.X] * 6,
+               [sc.X] * 6]
+    t = 1000
+    for vals in streams:
+        sup.process([Record("k", v, t + j) for j, v in enumerate(vals)])
+        t += 10
+        if sup.replans:
+            break
+    assert sup.replans >= 1 and sup.replan_failures == 0
+    span = sink.spans("replan")[0]
+    corr = span["corr"]
+    twins = [
+        s for s in sink.spans("supervisor.batch") if s["corr"] == corr
+    ]
+    assert len(twins) == 1  # resolves to exactly one real batch span
+    # The rebuilt processor carries the SAME ledger (continuity by
+    # reference, like the metrics registry) with the stall attributed.
+    assert sup.processor.ledger is ledger_before
+    ex = sup.processor.ledger.exemplars["stall.replan"]
+    assert ex["corr"] == corr and ex["seconds"] > 0
+    snap = sup.metrics_snapshot(per_lane=False)
+    assert snap["latency"]["stalls"]["replan"]["count"] == sup.replans
+
+
 # -- bank / sharded / stacked attribution -------------------------------------
 
 
@@ -445,7 +502,11 @@ def test_reporter_cadence_and_prometheus(tmp_path):
     rep.flush()
     lines = [json.loads(l) for l in buf.getvalue().splitlines()]
     assert [l["snapshot"]["n"] for l in lines] == [2, 4, 5]
-    assert open(prom).read() == "cep_n 5\n"
+    assert open(prom).read() == (
+        "# HELP cep_n runtime metric (see README metrics reference)\n"
+        "# TYPE cep_n gauge\n"
+        "cep_n 5\n"
+    )
 
 
 def test_configure_logging_json_lines():
