@@ -54,6 +54,10 @@ quarantine-entry latency, default 1;
 (end-to-end latency attribution: ledger on/off parity + overhead,
 per-segment p50/p99, drain-cadence and reorder-grace A/Bs, default 1;
 ``CEP_BENCH_LATENCY_{K,B,BATCHES,GRACE,DRAIN,RING}`` size it),
+``CEP_BENCH_OVERLOAD`` (brownout ladder under flood: goodput with and
+without the controller, auditable shed accounting, brownout batch-time
+tail, recovery-to-L0, default 1;
+``CEP_BENCH_OVERLOAD_{K,B,BATCHES,SUB,DEPTH}`` size it),
 ``CEP_PLATFORM`` (force a JAX platform, e.g. ``cpu``).
 
 All diagnostics go to stderr; stdout carries only the JSON line.
@@ -2351,6 +2355,176 @@ def bench_latency():
     return out
 
 
+def bench_overload():
+    """``CEP_BENCH_OVERLOAD``: brownout ladder under flood (ISSUE 20).
+
+    A dense flood (every record held by the watermark guard: the
+    event-time pressure signal saturates) drives the ladder L1→L4, then
+    a sparse subside tail lets it recover.  The same stream runs twice
+    through the supervised record path:
+
+    * **controller OFF** — the unprotected baseline: everything is
+      admitted, the reorder buffer evicts past its depth (order loss),
+      and the batch-time tail stretches with the backlog;
+    * **controller ON** — the ladder sheds at the door as typed
+      ``overload_shed`` dead letters; reported: admitted-goodput, the
+      shed fraction, the batch-time p99 while browned out, and how many
+      subside batches the ladder needs to step back to L0.
+
+    Flags for bench_gate: ``ledger_reconciles`` (``offered == admitted
+    + overload_shed + late_dropped + quarantined``, exactly — auditable
+    shedding, nothing silent) and ``recovers`` (final level 0, zero
+    failed transitions).  Record-path rates are host-bound
+    (µs/record Python), so the off/on comparison is relative, like
+    bench_ooo's.  ``CEP_BENCH_OVERLOAD_{K,B,BATCHES,SUB,DEPTH}`` size it.
+    """
+    import shutil
+    import tempfile
+
+    from kafkastreams_cep_tpu.runtime import Record, Supervisor
+    from kafkastreams_cep_tpu.runtime.ingest import IngestPolicy
+    from kafkastreams_cep_tpu.runtime.overload import OverloadPolicy
+
+    K = int(os.environ.get("CEP_BENCH_OVERLOAD_K", "64"))
+    n_batches = int(os.environ.get("CEP_BENCH_OVERLOAD_BATCHES", "8"))
+    batch_records = int(os.environ.get("CEP_BENCH_OVERLOAD_B", "2048"))
+    subside = int(os.environ.get("CEP_BENCH_OVERLOAD_SUB", "24"))
+    depth = int(os.environ.get("CEP_BENCH_OVERLOAD_DEPTH", "4096"))
+    cfg = EngineConfig(
+        max_runs=24, slab_entries=48, slab_preds=8, dewey_depth=12,
+        max_walk=12,
+    )
+    # Event-time-driven policy (the wall-clock signals are neutralized):
+    # pressure = reorder-hold occupancy / hold_ref, so the ladder
+    # trajectory is a pure function of the record stream — the same
+    # deterministic setup the overload test suite proves against.
+    policy = OverloadPolicy(
+        burn_ref=1e9, queue_ref=1e9, ring_ref=1e9, hold_age_ref=1e9,
+        hold_ref=0.05, enter_streak=1, exit_streak=2,
+    )
+    rng = np.random.default_rng(20)
+    N = n_batches * batch_records
+    grace = N  # ts advance +1/record: the whole flood sits in the window
+    keys = rng.integers(0, K, size=N)
+    prices = rng.integers(90, 131, size=N)
+    vols = np.where(
+        rng.random(N) < 0.005, 1100, rng.integers(700, 1000, size=N)
+    )
+    recs = [
+        Record(
+            int(keys[i]),
+            {"price": int(prices[i]), "volume": int(vols[i])},
+            i + 1,
+            offset=i,
+        )
+        for i in range(N)
+    ]
+    sub_recs = [
+        Record(
+            int(rng.integers(0, K)), {"price": 100, "volume": 800},
+            N + 1 + (j + 1) * 2 * grace, offset=N + j,
+        )
+        for j in range(subside)
+    ]
+
+    def run(overload):
+        tmp = tempfile.mkdtemp(prefix="cep-bench-ovl-")
+        try:
+            kw = dict(
+                checkpoint_path=os.path.join(tmp, "b.ckpt"),
+                journal_path=os.path.join(tmp, "b.jrnl"),
+                checkpoint_every=100, gc_interval=0,
+                ingest=IngestPolicy(grace_ms=grace, reorder_depth=depth),
+            )
+            if overload:
+                kw["overload_policy"] = policy
+            sup = Supervisor(stock_demo.stock_pattern(), K, cfg, **kw)
+            batch_s = []
+            levels = []
+            t0 = time.perf_counter()  # host-timed (record path)
+            for b in range(n_batches):
+                tb = time.perf_counter()  # host-timed (supervised batch)
+                sup.process(
+                    recs[b * batch_records:(b + 1) * batch_records]
+                )
+                batch_s.append(time.perf_counter() - tb)
+                if overload:
+                    levels.append(sup._overload.level)
+            flood_dt = time.perf_counter() - t0
+            recovery = None
+            for j, r in enumerate(sub_recs):
+                sup.process([r])
+                if overload and recovery is None \
+                        and sup._overload.level == 0:
+                    recovery = j + 1
+            sup.processor.drain_ingest()
+            sup.processor.flush()
+            g = sup.processor._guard
+            lc = g.loss_counters()
+            offered = N + subside
+            return {
+                "flood_dt": flood_dt,
+                "batch_p99_s": float(np.percentile(batch_s, 99)),
+                "levels": levels,
+                "recovery": recovery,
+                "admitted": g.admitted,
+                "loss": lc,
+                "reconciles": offered == g.admitted
+                + lc["overload_shed"] + lc["late_dropped"]
+                + lc["quarantined"],
+                "transitions": (
+                    sup._overload.transitions if overload else 0
+                ),
+                "transition_failures": (
+                    sup._overload.transition_failures if overload else 0
+                ),
+                "final_level": sup._overload.level if overload else 0,
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    off = run(False)
+    on = run(True)
+    out = {
+        "records": N + subside,
+        "reorder_depth": depth,
+        "evps_controller_off": round(N / off["flood_dt"], 1),
+        "goodput_controller_on": round(
+            (on["admitted"] - subside) / on["flood_dt"], 1
+        ),
+        "shed": on["loss"]["overload_shed"],
+        "shed_pct": round(
+            100 * on["loss"]["overload_shed"] / (N + subside), 1
+        ),
+        "evictions_off": off["loss"]["reorder_evictions"],
+        "evictions_on": on["loss"]["reorder_evictions"],
+        "batch_p99_off_s": round(off["batch_p99_s"], 4),
+        "batch_p99_on_s": round(on["batch_p99_s"], 4),
+        "max_level": max(on["levels"]),
+        "recovery_batches": on["recovery"],
+        "transitions": on["transitions"],
+        "ledger_reconciles": bool(on["reconciles"] and off["reconciles"]),
+        "recovers": bool(
+            on["final_level"] == 0
+            and on["recovery"] is not None
+            and on["transition_failures"] == 0
+        ),
+    }
+    log(
+        f"overload (K={K}, {N} flood records, depth {depth}): "
+        f"{out['evps_controller_off']} ev/s unprotected "
+        f"({out['evictions_off']} order-loss evictions) vs "
+        f"{out['goodput_controller_on']} admitted-ev/s browned out "
+        f"({out['shed']} shed = {out['shed_pct']}%, "
+        f"{out['evictions_on']} evictions), batch p99 "
+        f"{out['batch_p99_off_s']}s -> {out['batch_p99_on_s']}s, "
+        f"max level {out['max_level']}, L0 after "
+        f"{out['recovery_batches']} subside batches; ledger_reconciles="
+        f"{out['ledger_reconciles']}, recovers={out['recovers']}"
+    )
+    return out
+
+
 def bench_ooo():
     """``CEP_BENCH_OOO``: graceful-ingestion A/B (ISSUE 5).
 
@@ -2517,6 +2691,7 @@ def main():
     tenants = {}
     adapt = {}
     latency = {}
+    overload = {}
 
     def _shard_fault_block():
         # Nested under ``resilience`` so the JSON groups every
@@ -2591,6 +2766,14 @@ def main():
                 lambda: latency.update(
                     bench_latency()
                     if os.environ.get("CEP_BENCH_LATENCY", "1") == "1"
+                    else {}
+                ),
+            ),
+            (
+                "overload",
+                lambda: overload.update(
+                    bench_overload()
+                    if os.environ.get("CEP_BENCH_OVERLOAD", "1") == "1"
                     else {}
                 ),
             ),
@@ -2753,6 +2936,12 @@ def main():
                 # reorder-grace A/Bs (None when extras skipped or
                 # CEP_BENCH_LATENCY=0).
                 "latency": latency or None,
+                # Overload control (ISSUE 20): brownout ladder under
+                # flood — goodput with/without the controller, typed
+                # shed accounting (ledger_reconciles), brownout
+                # batch-time tail, recovery-to-L0 (None when extras
+                # skipped or CEP_BENCH_OVERLOAD=0).
+                "overload": overload or None,
             }
         ),
         flush=True,

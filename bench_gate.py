@@ -70,6 +70,8 @@ FLAG_METRICS = (
     "tenant_iso_compliant_lossfree",
     "latency_parity",
     "latency_ab_parity",
+    "overload_ledger_reconciles",
+    "overload_recovers",
 )
 #: Ratio metrics guarded like rates (0..1, higher is better).
 RATIO_METRICS = ("recall_sampled",)
@@ -141,6 +143,16 @@ def extract_metrics(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             and not isinstance(p99, bool) and p99 > 0
         ):
             out["latency_e2e_p99_s"] = float(p99)
+    overload = parsed.get("overload")
+    if isinstance(overload, dict):
+        # Nested overload block (BENCH_r11+) -> flat ``overload_*``
+        # keys: the brownout loss ledger must keep reconciling exactly
+        # (offered == admitted + shed + dead-lettered) and the ladder
+        # must keep recovering to L0 once the flood subsides.
+        flat["overload_ledger_reconciles"] = overload.get(
+            "ledger_reconciles"
+        )
+        flat["overload_recovers"] = overload.get("recovers")
     adapt = parsed.get("adapt")
     if isinstance(adapt, dict):
         # Nested adapt block (BENCH_r08+) -> flat ``adapt_*`` keys: the

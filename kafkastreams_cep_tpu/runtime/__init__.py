@@ -34,6 +34,10 @@ from kafkastreams_cep_tpu.runtime.ingest import (
     IngestGuard,
     IngestPolicy,
 )
+from kafkastreams_cep_tpu.runtime.overload import (
+    OverloadController,
+    OverloadPolicy,
+)
 from kafkastreams_cep_tpu.runtime.migrate import (
     migrate_processor,
     move_lanes,
@@ -58,6 +62,8 @@ __all__ = [
     "IngestGuard",
     "IngestPolicy",
     "InputRejected",
+    "OverloadController",
+    "OverloadPolicy",
     "Record",
     "ShardPolicy",
     "Supervisor",

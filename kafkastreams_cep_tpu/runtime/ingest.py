@@ -26,10 +26,12 @@ concern); this module is the TPU runtime's front door analog:
   :class:`InputRejected` behavior.
 
 * **Loss counters.**  ``late_dropped`` (event time older than the
-  watermark at arrival), ``quarantined`` (validation defects), and
+  watermark at arrival), ``quarantined`` (validation defects),
   ``reorder_evictions`` (buffer-depth overflow force-released a record
-  before its watermark).  All three zero ⇒ the guard was loss-free and
-  the release stream is exactly the sorted admitted stream.
+  before its watermark), and ``overload_shed`` (admissible records shed
+  by the brownout ladder, ``runtime/overload.py``).  All zero ⇒ the
+  guard was loss-free and the release stream is exactly the sorted
+  admitted stream.
 
 The guard is first-class durable state: :func:`IngestGuard.to_state`
 round-trips through the checkpoint header (``runtime/checkpoint.py``),
@@ -63,6 +65,7 @@ REASON_LANE_OVERFLOW = "lane_overflow"
 REASON_TIME_RANGE = "time_range"
 REASON_LATE = "late"
 REASON_TENANT_QUOTA = "tenant_quota"
+REASON_OVERLOAD_SHED = "overload_shed"
 
 REASONS = (
     REASON_SCHEMA,
@@ -70,6 +73,7 @@ REASONS = (
     REASON_TIME_RANGE,
     REASON_LATE,
     REASON_TENANT_QUOTA,
+    REASON_OVERLOAD_SHED,
 )
 
 #: reason -> (trigger description, loss counter it lands in).  Drives the
@@ -98,6 +102,13 @@ REASON_DOCS: Dict[str, tuple] = {
         "tenant over its admission token bucket, or traffic for a "
         "quarantined tenant (runtime/tenant.py `AdmissionPolicy`)",
         "`admission_shed` / `admission_quarantined_dropped` (per tenant)",
+    ),
+    REASON_OVERLOAD_SHED: (
+        "brownout ladder at L3+ shedding admissible records at ingest "
+        "(runtime/overload.py `OverloadController`); deterministic "
+        "within-batch stride, so `offered == admitted + shed + "
+        "dead_lettered` reconciles exactly",
+        "`overload_shed`",
     ),
 }
 
@@ -136,6 +147,14 @@ class AdmissionLimiter:
     New tenants start with a full burst.  Pure deterministic host state:
     :meth:`to_state` round-trips through the checkpoint header and
     replays identically from the supervisor journal.
+
+    Under brownout (runtime/overload.py L2+) :meth:`set_pressure`
+    tightens every bucket proportionally to the tenant's measured cost
+    share: the heaviest tenant's refill rate (and a new tenant's initial
+    burst) is multiplied by ``scale``, a zero-share tenant keeps factor
+    1.0, and tenants with no measured share get the conservative
+    ``scale``.  Pressure is part of :meth:`to_state` so a replayed crash
+    admits the same records.
     """
 
     def __init__(self, rate_per_batch: float, burst: Optional[float] = None):
@@ -148,17 +167,48 @@ class AdmissionLimiter:
             1.0, 2.0 * self.rate
         )
         self.tokens: Dict[str, float] = {}
+        self.pressure_scale: float = 1.0
+        self.pressure_shares: Dict[str, float] = {}
+
+    def set_pressure(
+        self, scale: float, shares: Optional[Dict[str, float]] = None
+    ) -> None:
+        """Apply (or at ``scale=1.0`` clear) overload pressure: the
+        supervisor's brownout controller calls this on every transition
+        and after every restore/migration, so it must be idempotent."""
+        self.pressure_scale = min(1.0, max(0.0, float(scale)))
+        self.pressure_shares = {
+            str(k): float(v) for k, v in (shares or {}).items()
+        }
+
+    def _factor(self, tenant: str) -> float:
+        if self.pressure_scale >= 1.0:
+            return 1.0
+        shares = self.pressure_shares
+        if not shares:
+            return self.pressure_scale
+        share = shares.get(tenant)
+        if share is None:
+            # Unmeasured tenant: no evidence it is cheap, so it gets the
+            # full squeeze rather than a free pass.
+            return self.pressure_scale
+        max_share = max(shares.values())
+        if max_share <= 0:
+            return 1.0
+        return 1.0 - (1.0 - self.pressure_scale) * (share / max_share)
 
     def refill(self) -> None:
         for tenant in self.tokens:
             self.tokens[tenant] = min(
-                self.burst, self.tokens[tenant] + self.rate
+                self.burst, self.tokens[tenant] + self.rate * self._factor(
+                    tenant
+                )
             )
 
     def admit(self, tenant: str) -> bool:
         bucket = self.tokens.get(tenant)
         if bucket is None:
-            bucket = self.burst
+            bucket = self.burst * self._factor(tenant)
         if bucket < 1.0:
             self.tokens[tenant] = bucket
             return False
@@ -170,12 +220,20 @@ class AdmissionLimiter:
             "rate": self.rate,
             "burst": self.burst,
             "tokens": dict(self.tokens),
+            "pressure_scale": self.pressure_scale,
+            "pressure_shares": dict(self.pressure_shares),
         }
 
     @classmethod
     def from_state(cls, state: Dict[str, Any]) -> "AdmissionLimiter":
         lim = cls(state["rate"], state["burst"])
         lim.tokens = {str(k): float(v) for k, v in state["tokens"].items()}
+        # Pre-overload checkpoints carry no pressure keys: default open.
+        lim.pressure_scale = float(state.get("pressure_scale", 1.0))
+        lim.pressure_shares = {
+            str(k): float(v)
+            for k, v in state.get("pressure_shares", {}).items()
+        }
         return lim
 
 
@@ -281,6 +339,7 @@ class IngestGuard:
         self.late_dropped = 0
         self.quarantined = 0
         self.reorder_evictions = 0
+        self.overload_shed = 0
         # Non-loss telemetry.
         self.admitted = 0
         self.released = 0
@@ -328,10 +387,23 @@ class IngestGuard:
                 self.frontier, ent[0]
             )
 
+    def observe_time(self, ts: int) -> None:
+        """Advance event time without admitting the record (brownout
+        sheds): a shed record's timestamp is still *observed*, so the
+        watermark keeps moving, held records keep releasing, and the
+        backlog clears even while the door is closed (L4 would otherwise
+        deadlock — nothing admits, so nothing ever releases)."""
+        ts = int(ts)
+        self.max_seen = ts if self.max_seen is None else max(
+            self.max_seen, ts
+        )
+
     def quarantine(self, record, reason: str, detail: str, corr: str) -> None:
         """Divert one record to the dead-letter queue with a typed reason."""
         if reason == REASON_LATE:
             self.late_dropped += 1
+        elif reason == REASON_OVERLOAD_SHED:
+            self.overload_shed += 1
         else:
             self.quarantined += 1
         self.reason_counts[reason] = self.reason_counts.get(reason, 0) + 1
@@ -399,6 +471,7 @@ class IngestGuard:
             "late_dropped": self.late_dropped,
             "quarantined": self.quarantined,
             "reorder_evictions": self.reorder_evictions,
+            "overload_shed": self.overload_shed,
         }
 
     def stats(self) -> Dict[str, int]:
@@ -432,6 +505,7 @@ class IngestGuard:
             "late_dropped": self.late_dropped,
             "quarantined": self.quarantined,
             "reorder_evictions": self.reorder_evictions,
+            "overload_shed": self.overload_shed,
             "admitted": self.admitted,
             "released": self.released,
             "dead_letter_dropped": self.dead_letter_dropped,
@@ -458,6 +532,8 @@ class IngestGuard:
         guard.late_dropped = int(state["late_dropped"])
         guard.quarantined = int(state["quarantined"])
         guard.reorder_evictions = int(state["reorder_evictions"])
+        # Pre-overload checkpoints carry no shed counter: default zero.
+        guard.overload_shed = int(state.get("overload_shed", 0))
         guard.admitted = int(state["admitted"])
         guard.released = int(state["released"])
         guard.dead_letter_dropped = int(state["dead_letter_dropped"])

@@ -40,12 +40,14 @@ from kafkastreams_cep_tpu.parallel.batch import BatchMatcher
 from kafkastreams_cep_tpu.runtime.ingest import (
     REASON_LANE_OVERFLOW,
     REASON_LATE,
+    REASON_OVERLOAD_SHED,
     REASON_SCHEMA,
     REASON_TIME_RANGE,
     Defect,
     IngestGuard,
     IngestPolicy,
 )
+from kafkastreams_cep_tpu.runtime.overload import shed_keep as _shed_keep
 from kafkastreams_cep_tpu.utils import tracecache
 from kafkastreams_cep_tpu.utils.events import Event, Sequence
 from kafkastreams_cep_tpu.utils.failpoints import fire as _failpoint
@@ -301,6 +303,15 @@ class CEPProcessor:
         # quarantine-burst — None costs one check per batch.
         self.flight = flight
         self._dlq_base = 0  # dead-letter total at last batch (burst detect)
+        # Brownout actuators (runtime/overload.py, set by the supervisor's
+        # OverloadController — never directly by callers):
+        # ``overload_admit_fraction`` None = door open; otherwise the
+        # fraction of admissible records kept at the ingest door, via a
+        # deterministic within-batch Bresenham stride (0.0 = L4, refuse
+        # all).  ``telemetry_defer`` skips the per-lane/per-key device
+        # gathers in metrics_snapshot while browned out.
+        self.overload_admit_fraction: Optional[float] = None
+        self.telemetry_defer = False
 
     def set_clock(self, clock) -> None:
         """Re-inject the host clock everywhere it is read (processor
@@ -414,9 +425,35 @@ class CEPProcessor:
         # batch is rejected wholesale, nothing half-admitted.
         _failpoint("ingest.admit")
         strict = guard.policy.on_bad_record == "raise"
+        admit_frac = self.overload_admit_fraction
+        n_admissible = 0
         for idx, rec in enumerate(records):
             defect = self._record_defect(rec)
             if defect is None:
+                # Brownout shed (runtime/overload.py L3+): AFTER
+                # validation and replay dedup — source_hw already
+                # advanced, so a re-submitted shed record dedups silently
+                # instead of double-counting — and the Bresenham index
+                # runs over admissible records only, so replaying the
+                # same batch sheds the same records.
+                keep = admit_frac is None or _shed_keep(
+                    n_admissible, admit_frac
+                )
+                n_admissible += 1
+                if not keep:
+                    # Fault site: the shed decision is made but not yet
+                    # recorded — recovery replays the batch from the
+                    # snapshot + journal and re-sheds deterministically.
+                    _failpoint("overload.shed")
+                    guard.quarantine(
+                        rec, REASON_OVERLOAD_SHED,
+                        f"brownout admit fraction {admit_frac}", corr,
+                    )
+                    # The shed record's event time is still observed:
+                    # the watermark keeps advancing so the held backlog
+                    # drains while the door is throttled/closed.
+                    guard.observe_time(rec.timestamp)
+                    continue
                 guard.push(rec)
                 continue
             if defect.silent:
@@ -1424,7 +1461,9 @@ class CEPProcessor:
             # lazy-chain-ordering signal, labeled by stage name in the
             # Prometheus rendering.
             snap["per_stage"] = per_stage
-        if per_lane:
+        # Brownout L1+ defers the per-lane/per-key device gathers — the
+        # one part of the snapshot that costs device round-trips.
+        if per_lane and not self.telemetry_defer:
             snap["per_lane"] = self.batch.per_lane_counters(self.state)
             snap["per_key"] = self.per_key_cost(
                 per_lane_arrays=snap["per_lane"]

@@ -68,6 +68,10 @@ from kafkastreams_cep_tpu.native.journal import Journal
 from kafkastreams_cep_tpu.parallel.sharding import ShardLost, surviving_mesh
 from kafkastreams_cep_tpu.runtime import checkpoint as ckpt_mod
 from kafkastreams_cep_tpu.runtime import migrate as migrate_mod
+from kafkastreams_cep_tpu.runtime.overload import (
+    MAX_LEVEL as _OVERLOAD_MAX_LEVEL,
+    OverloadController,
+)
 from kafkastreams_cep_tpu.runtime.processor import (
     CEPProcessor,
     InputRejected,
@@ -256,6 +260,7 @@ class Supervisor:
         shard_policy: Optional[ShardPolicy] = None,
         shard_probe=None,
         adapt_policy=None,
+        overload_policy=None,
         _resuming: bool = False,
         **proc_kwargs,
     ):
@@ -433,6 +438,28 @@ class Supervisor:
         self.flight = self._proc_kwargs.get("flight")
         if self.flight is not None:
             self.processor.flight = self.flight
+        # Brownout ladder (runtime/overload.py): ``True`` takes the
+        # default OverloadPolicy, a policy instance tunes
+        # thresholds/actuators, None/False disables.  The controller is
+        # supervisor-owned durable state: its level rides the checkpoint
+        # header (``extra["overload"]``) and every transition is pinned
+        # with an immediate snapshot, so recovery/resume/migration land
+        # in the same level and replay under the same actuators.
+        if overload_policy is True:
+            self._overload: Optional[OverloadController] = (
+                OverloadController()
+            )
+        elif overload_policy:
+            self._overload = OverloadController(overload_policy)
+        else:
+            self._overload = None
+        # Optional caller-owned admission front door (runtime/tenant.py
+        # TenantAdmission, or a bare AdmissionLimiter) the L2 actuator
+        # squeezes — see attach_admission().
+        self._admission = None
+        if self._overload is not None:
+            self._overload.base_drain = self.processor.drain_interval
+            self._overload_wire()
 
     @classmethod
     def resume(
@@ -464,6 +491,7 @@ class Supervisor:
         """
         proc = None
         base_seq = 0
+        overload_state = None
         candidates = []
         if checkpoint_path:
             candidates = [
@@ -476,7 +504,9 @@ class Supervisor:
                 proc = ckpt_mod.restore_processor(
                     pattern, path, ckpt=ckpt, mesh=kwargs.get("mesh"),
                 )
-                base_seq = int(ckpt["header"].get("extra", {}).get("seq", 0))
+                extra = ckpt["header"].get("extra", {})
+                base_seq = int(extra.get("seq", 0))
+                overload_state = extra.get("overload")
                 break
             except ckpt_mod.CheckpointCorrupt:
                 logger.exception(
@@ -496,6 +526,21 @@ class Supervisor:
         # An injected (restored) processor carries no telemetry wiring.
         sup.processor.trace = sup.trace
         sup.processor.flight = sup.flight
+        # The clock is wiring too (checkpoints carry no callables): a
+        # pinned clock must keep ticking the restored guard and ledger —
+        # without this the SLO tracker's burn-rate window (restored from
+        # the checkpoint header) would observe wall-clock stamps against
+        # pinned-clock history and the controller's input would be junk.
+        clock = sup._proc_kwargs.get("clock")
+        if clock is not None:
+            sup.processor.set_clock(clock)
+        # Load the pinned brownout level BEFORE the journal replay: every
+        # journaled batch was processed at the pinned level (transitions
+        # checkpoint immediately, truncating the journal), so replay must
+        # run under the same actuators to shed the same records.
+        if sup._overload is not None and overload_state:
+            sup._overload.load_state(overload_state)
+        sup._overload_wire()
         replayed = skipped = 0
         if sup._disk_journal is not None:
             # The chain: the retired ``.prev`` generation first (frames at
@@ -525,6 +570,7 @@ class Supervisor:
                         gap = True
                         break
                     sup.processor.process(batch)  # matches already emitted
+                    sup._overload_replay_tick()
                     sup._journal.append(batch)
                     sup._batches_since_ckpt += 1
                     sup._seq = seq
@@ -564,9 +610,10 @@ class Supervisor:
             if self.processor.pipeline:
                 self._unclaimed.extend(self.processor.flush())
             tmp = self.checkpoint_path + ".tmp"
-            ckpt_mod.save_checkpoint(
-                self.processor, tmp, extra={"seq": self._seq}
-            )
+            extra = {"seq": self._seq}
+            if self._overload is not None:
+                extra["overload"] = self._overload.to_state()
+            ckpt_mod.save_checkpoint(self.processor, tmp, extra=extra)
             # Fault site: the crash window between writing the tmp snapshot
             # and atomically installing it (utils/failpoints.py).
             _failpoint("checkpoint.rename")
@@ -768,6 +815,17 @@ class Supervisor:
                         self._seq,
                     )
         self._batches_since_ckpt += 1
+        # Overload/SLO observation BEFORE the cadence snapshot below: a
+        # batch's tick must be pinned together with the batch itself, or
+        # a crash landing right after the snapshot restores streaks that
+        # are one observation behind the crash-free run — and since the
+        # batch is inside the checkpoint it is never re-submitted, so the
+        # lost tick can never be replayed (the ladder would then exit a
+        # brownout level one batch late and shed records an uncrashed
+        # run admits).  A transition taken here pins its own snapshot,
+        # which also resets the cadence counter.
+        self._slo_tick(corr)
+        self._overload_tick(corr)
         # A suspended journal means acknowledged batches are NOT in the
         # crash history — don't wait out the cadence, close the window by
         # snapshotting immediately (a successful snapshot contains the
@@ -793,7 +851,6 @@ class Supervisor:
                 logger.exception("checkpoint failed; journal retained")
         if self._policy is not None:
             self._maybe_escalate_ingest()
-        self._slo_tick(corr)
         if self._unclaimed:
             # A failed snapshot above still flushed the pipeline; those
             # matches belong to the caller either way.
@@ -862,6 +919,10 @@ class Supervisor:
             self.processor = CEPProcessor(
                 self._pattern, num_lanes, config, **self._proc_kwargs
             )
+        # Re-wire the brownout actuators BEFORE the replay: every
+        # journaled batch ran at the pinned level (transitions snapshot
+        # immediately), so replay must shed under the same actuators.
+        self._overload_wire()
         replayed = 0
         for batch in self._journal:
             self.processor.process(batch)  # matches already emitted
@@ -912,6 +973,189 @@ class Supervisor:
                 self.flight.dump("slo_burn", corr=corr)
         elif burn <= 1.0 and self._slo_burning:
             self._slo_burning = False
+
+    # -- overload control (runtime/overload.py) ------------------------------
+
+    def attach_admission(self, admission) -> None:
+        """Register the caller-owned tenant admission front door
+        (runtime/tenant.py ``TenantAdmission``, or a bare
+        ``AdmissionLimiter``) so the L2 actuator can squeeze its token
+        buckets proportionally to measured tenant cost.  Idempotent —
+        re-applies the current pinned pressure immediately, so callers
+        re-attach after their own restore."""
+        self._admission = admission
+        self._overload_wire()
+
+    def _overload_limiter(self):
+        adm = self._admission
+        if adm is None:
+            return None
+        return getattr(adm, "limiter", adm)
+
+    def _overload_wire(self) -> None:
+        """Re-apply the pinned level's actuators — after any processor
+        rebuild or swap (restore, resume, migration, rebalance, replan)
+        the new processor carries default actuators and must be re-wired
+        before it processes (or replays) anything."""
+        if self._overload is not None:
+            self._overload_apply()
+
+    def _overload_apply(self) -> None:
+        ctl = self._overload
+        proc = self.processor
+        base = max(int(ctl.base_drain), 1)
+        proc.drain_interval = max(1, base * ctl.drain_widen())
+        proc.telemetry_defer = ctl.telemetry_defer()
+        proc.overload_admit_fraction = ctl.admit_fraction()
+        lim = self._overload_limiter()
+        if lim is not None:
+            scale, shares = ctl.admission_pressure
+            lim.set_pressure(scale, shares)
+
+    def _overload_signals(self) -> dict:
+        """The pressure inputs, all host-side (no per-batch device
+        reads): SLO burn rate, reorder hold depth/age, ingest-queue
+        segment p99, and the deferred-drain backlog (the host proxy for
+        handle-ring occupancy).  Missing subsystems contribute nothing —
+        a processor without a guard or ledger reads pressure 0."""
+        sig: dict = {}
+        proc = self.processor
+        guard = getattr(proc, "_guard", None)
+        if guard is not None:
+            depth = guard.policy.reorder_depth
+            if depth:
+                sig["hold_frac"] = guard.held / depth
+            grace = guard.policy.grace_ms
+            if grace > 0:
+                sig["hold_age_frac"] = guard.hold_age_ms() / grace
+        ledger = getattr(proc, "ledger", None)
+        if ledger is not None:
+            if ledger.slo is not None:
+                sig["burn_rate"] = ledger.slo.burn_rate()
+            hist = ledger._hists.get("queue")
+            if hist is not None:
+                sig["queue_p99_s"] = hist.percentile(0.99)
+            sig["ring_depth"] = len(ledger._deferred)
+        return sig
+
+    def _overload_shares(self) -> dict:
+        """Per-tenant cost shares from the heavy-hitter attribution
+        (per_key_cost top list), mapped through the admission policy's
+        key→tenant function — the L2 squeeze is proportional to measured
+        cost, not record count.  One device gather, paid only on an L2+
+        transition (never per batch)."""
+        adm = self._admission
+        if adm is None:
+            return {}
+        policy = getattr(adm, "policy", None)
+        key_tenant = getattr(policy, "key_tenant", None) or str
+        try:
+            top = self.processor.per_key_cost().get("top") or []
+        except Exception:
+            logger.exception(
+                "per-key cost attribution failed; squeezing all tenants "
+                "uniformly"
+            )
+            return {}
+        shares: dict = {}
+        for row in top:
+            tenant = str(key_tenant(row["key"]))
+            shares[tenant] = shares.get(tenant, 0.0) + float(row["share"])
+        return shares
+
+    def _overload_replay_tick(self) -> None:
+        """Advance the controller's observation streaks for one REPLAYED
+        batch without taking transitions.  The crashed process ticked
+        once per journaled batch after the last pin; a cold resume
+        restores the PINNED streaks, so replay must re-run those
+        observations or the resumed ladder would trail the crash-free
+        trajectory by the journal window (holding a brownout level — and
+        shedding — for extra batches an uncrashed run would not).  A
+        transition cannot legitimately arise here: a committed
+        transition pins a snapshot that truncates the journal, so every
+        replayed batch was a no-transition tick in the original run.  A
+        proposal (possible only from nondeterministic wall-clock
+        signals) is deferred, not dropped — streaks are retained at
+        threshold, so the first live batch re-proposes and commits it
+        under the full transition protocol."""
+        ctl = self._overload
+        if ctl is None:
+            return
+        guard = getattr(self.processor, "_guard", None)
+        if guard is not None:
+            ctl.shed_total = guard.overload_shed
+        ctl.tick(self._overload_signals())
+
+    def _overload_tick(self, corr: str) -> None:
+        """One controller observation per batch (after _slo_tick, before
+        the unclaimed drain).  A proposal runs the transition protocol;
+        no proposal costs a few host float compares."""
+        ctl = self._overload
+        if ctl is None:
+            return
+        guard = getattr(self.processor, "_guard", None)
+        if guard is not None:
+            ctl.shed_total = guard.overload_shed
+        proposal = ctl.tick(self._overload_signals())
+        if proposal is not None:
+            self._overload_transition(proposal[0], proposal[1], corr)
+
+    def _overload_transition(
+        self, from_level: int, to_level: int, corr: str
+    ) -> None:
+        """The supervisor-owned transition protocol: failpoint →
+        tentative level → actuators → pin checkpoint → commit.  ANY
+        failure (armed failpoint, pin-snapshot failure) reverts level
+        and actuators — the previous level stays authoritative, keeping
+        the invariant that the in-memory level always equals the
+        last-pinned level (so recovery replay never spans a
+        transition)."""
+        ctl = self._overload
+        entering = to_level > from_level
+        site = "overload.enter" if entering else "overload.exit"
+        try:
+            with maybe_span(
+                self.trace, "overload.transition", corr=corr,
+                from_level=from_level, to_level=to_level,
+                pressure=round(ctl.last_pressure, 4),
+            ):
+                # Fault site: before actuators apply or the level pins —
+                # a crash here must leave the previous level live.
+                _failpoint(site)
+                ctl.begin(to_level)
+                scale = ctl.admission_scale(to_level)
+                ctl.admission_pressure = (
+                    float(scale),
+                    dict(self._overload_shares()) if scale < 1.0 else {},
+                )
+                self._overload_apply()
+                if entering and to_level >= _OVERLOAD_MAX_LEVEL:
+                    # Emergency entry: flush pinned drains so the pin
+                    # snapshot carries them.  Flushed matches are
+                    # observable emission — they ride _unclaimed out.
+                    self._unclaimed.extend(self.processor.flush())
+                # Pin: the transition exists only once snapshotted — a
+                # replayed crash must land in the same level.
+                self._unclaimed.extend(self.checkpoint())
+        except Exception:
+            ctl.abort()
+            self._overload_apply()
+            logger.exception(
+                "overload transition L%d -> L%d failed; L%d stays "
+                "authoritative", from_level, to_level, from_level,
+            )
+            return
+        ctl.commit()
+        if self.flight is not None:
+            self.flight.note(
+                overload_level=to_level,
+                overload_pressure=round(ctl.last_pressure, 4),
+            )
+            if entering and to_level >= 3:
+                # L3+ entry is the incident boundary: ship the last-N
+                # batches of context while the ring still holds the
+                # flood that forced the shed.
+                self.flight.dump("overload", corr=corr)
 
     def _recover(self, corr: Optional[str] = None) -> None:
         # ``corr`` correlates the recovery span with the batch span whose
@@ -1173,6 +1417,7 @@ class Supervisor:
                 return
             self.processor.trace = self.trace
             self.processor.flight = self.flight
+            self._overload_wire()
             self.rebalances += 1
             self.lanes_moved += moved
             # The baseline must follow its lanes to the new positions.
@@ -1307,6 +1552,7 @@ class Supervisor:
                 return
             self.processor.trace = self.trace
             self.processor.flight = self.flight
+            self._overload_wire()
             self.replans += 1
             self._replan_streak = 0
             self._boundaries_since_replan = 0
@@ -1430,6 +1676,7 @@ class Supervisor:
                 )
                 self.processor.trace = self.trace
                 self.processor.flight = self.flight
+                self._overload_wire()
                 self.escalations += 1
                 logger.warning(
                     "capacity escalation #%d: %s after counters %s; "
@@ -1558,6 +1805,10 @@ class Supervisor:
         out["stragglers"] = self.stragglers
         if self.flight is not None:
             out["flight_dumps"] = self.flight.dumps
+        if self._overload is not None:
+            # cep_overload_level / _pressure / _transitions /
+            # _transition_failures gauges (README metrics reference).
+            out.update(self._overload.metrics())
         out["retry_backoff_ms_total"] = round(self.retry_backoff_ms_total, 3)
         phases = dict(out.get("phases") or {})
         phases.update(

@@ -70,6 +70,17 @@ Sites currently threaded through the runtime:
                        quarantine``, before any enforcement state flips —
                        a fault here must leave the bank un-quarantined
                        and fully live
+``overload.enter``     the brownout ladder's level-up protocol
+                       (``runtime/supervisor.py _overload_transition``),
+                       before actuators apply or the level pins — a fault
+                       here must leave the previous level authoritative
+``overload.exit``      the same protocol stepping down — identical
+                       contract on the recovery direction
+``overload.shed``      the ingest-door shed path at L3+
+                       (``CEPProcessor._ingest``), after the Bresenham
+                       keep/shed decision but before the dead letter is
+                       recorded — recovery replays the batch and re-sheds
+                       deterministically
 =====================  ====================================================
 """
 
@@ -254,6 +265,11 @@ SITES = (
     "tenant.misbehave",
     "quota.shed",
     "quarantine.enter",
+    # Brownout ladder sites (runtime/supervisor.py transition protocol +
+    # the processor's ingest-door shed; see the docstring table).
+    "overload.enter",
+    "overload.exit",
+    "overload.shed",
 )
 
 

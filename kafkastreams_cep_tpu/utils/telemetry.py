@@ -606,6 +606,29 @@ METRIC_HELP: Dict[str, str] = {
     ),
     "key_hops_total": "Walk-kernel hop work summed over all keys",
     "key_hops": "Walk-kernel hop work for the top-K heaviest keys",
+    "overload_level": (
+        "Brownout ladder level (runtime/overload.py): 0 healthy, 1 "
+        "telemetry/drain degraded, 2 admission squeezed, 3 shedding, "
+        "4 emergency admission stop"
+    ),
+    "overload_pressure": (
+        "Overload pressure scalar: max of the normalized controller "
+        "signals (SLO burn, reorder hold depth/age, queue p99, drain "
+        "backlog); 1.0 = at the L1 entry reference"
+    ),
+    "overload_transitions": (
+        "Committed brownout ladder transitions (either direction), each "
+        "pinned by a checkpoint"
+    ),
+    "overload_transition_failures": (
+        "Aborted ladder transition protocols (failpoint or pin-snapshot "
+        "failure); the previous level stayed authoritative"
+    ),
+    "overload_shed": (
+        "Admissible records shed at the ingest door under brownout "
+        "(L3+), each a typed overload_shed dead letter — offered == "
+        "admitted + shed + dead_lettered reconciles exactly"
+    ),
 }
 
 

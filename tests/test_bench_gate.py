@@ -260,3 +260,42 @@ def test_gate_guards_latency_flags_and_p99_ceiling():
             bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r09.json"))
         ) or {}
     )
+
+
+def test_gate_guards_overload_flags():
+    """From BENCH_r11 on, the nested ``overload`` block flattens into
+    the guarded ``overload_*`` flags: the brownout loss ledger must keep
+    reconciling exactly (``offered == admitted + shed + dead_lettered``)
+    and the ladder must keep recovering to L0 once the flood subsides —
+    a later round may not regress either (ISSUE 20 satellite)."""
+    r11 = bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r11.json"))
+    m = bench_gate.extract_metrics(r11)
+    assert m["overload_ledger_reconciles"] is True
+    assert m["overload_recovers"] is True
+    # The new round itself gates clean against the full history.
+    history = [
+        bench_gate.load_doc(p)
+        for p in sorted(glob.glob(os.path.join(_ROOT, "BENCH_r*.json")))
+        if not p.endswith("BENCH_r11.json")
+    ]
+    ok, report = bench_gate.gate(r11, history)
+    assert ok, report
+    for key, metric in (
+        ("ledger_reconciles", "overload_ledger_reconciles"),
+        ("recovers", "overload_recovers"),
+    ):
+        bad = json.loads(json.dumps(r11))
+        bad["parsed"]["overload"][key] = False
+        ok, report = bench_gate.gate(bad, [r11])
+        assert not ok
+        assert any(
+            c["metric"] == metric and not c["ok"]
+            for c in report["checks"]
+        )
+    # Rounds predating the overload block stay unguarded on these flags,
+    # so the historical trajectory replays clean.
+    assert "overload_ledger_reconciles" not in (
+        bench_gate.extract_metrics(
+            bench_gate.load_doc(os.path.join(_ROOT, "BENCH_r10.json"))
+        ) or {}
+    )

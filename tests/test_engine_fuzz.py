@@ -9,6 +9,7 @@ whole reachable state)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import engine_scenarios as sc
 from kafkastreams_cep_tpu import OracleNFA
@@ -134,7 +135,10 @@ def test_fuzz_kleene():
     assert n == 240
 
 
+@pytest.mark.slow
 def test_fuzz_skip_till_any():
+    # Tier-2 (-m slow, ~18 s): strict3 + kleene fuzz keep the oracle
+    # fuzz loop in tier-1 (ROADMAP tier-1 budget note, PR 13).
     n = fuzz_family(
         sc.skip_till_any,
         letters([0.30, 0.25, 0.25, 0.15, 0.05]),
@@ -147,7 +151,10 @@ def test_fuzz_skip_till_any():
     assert n == 240
 
 
+@pytest.mark.slow
 def test_fuzz_stock():
+    # Tier-2 (-m slow, ~28 s): strict3 + kleene fuzz keep the oracle
+    # fuzz loop in tier-1 (ROADMAP tier-1 budget note, PR 13).
     def make(rng, N, T):
         prices = rng.integers(90, 131, size=(N, T))
         volumes = rng.integers(600, 1101, size=(N, T))

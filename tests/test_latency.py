@@ -189,7 +189,16 @@ def test_ledger_on_off_parity_jnp():
 
 @pytest.mark.parametrize(
     "env,mode",
-    [("CEP_WALK_KERNEL", "interpret"), ("CEP_SCAN_KERNEL", "interpret")],
+    [
+        ("CEP_WALK_KERNEL", "interpret"),
+        # The scan-kernel interpret variant is tier-2 (-m slow): it
+        # re-executes the whole scan per step in Python (~104 s); the
+        # walk-kernel variant keeps interpret parity in tier-1
+        # (ROADMAP tier-1 budget note, PR 13).
+        pytest.param(
+            "CEP_SCAN_KERNEL", "interpret", marks=pytest.mark.slow
+        ),
+    ],
 )
 def test_ledger_on_off_parity_kernels(env, mode):
     """The same parity through the Pallas walk/scan kernels (interpret
@@ -336,6 +345,43 @@ def test_slo_burn_exported_from_processor():
     txt = render_prometheus(snap)
     assert "cep_slo_burn 100" in txt
     assert "# TYPE cep_slo_burn gauge" in txt
+
+
+def test_slo_burn_window_survives_supervisor_resume(tmp_path):
+    """Regression (ISSUE 20 satellite): the SLO tracker's rolling window
+    rides the checkpoint header, AND ``Supervisor.resume`` re-injects
+    the pinned clock into the restored processor — without the clock
+    re-injection the restored burn window would mix pinned-clock history
+    with wall-clock stamps and the overload controller would read a
+    garbage burn signal after every crash."""
+    from kafkastreams_cep_tpu.runtime import Supervisor
+
+    clock = FakeClock(step=0.01)
+    kw = dict(
+        checkpoint_path=str(tmp_path / "slo.ckpt"),
+        journal_path=str(tmp_path / "slo.jrnl"),
+        checkpoint_every=1, gc_interval=0,
+        ingest=IngestPolicy(grace_ms=0), clock=clock,
+        latency=LatencyLedger(
+            slo=SLOTracker(threshold_s=1e-6), clock=clock
+        ),
+    )
+    sup = Supervisor(sc.strict3(), 1, sc.default_config(), **kw)
+    for i, v in enumerate([sc.A, sc.B, sc.C]):
+        sup.process([Record("k", v, 1000 + i, offset=i)])
+    want_burn = sup.processor.ledger.slo.burn_rate()
+    assert want_burn > 0  # the tight threshold is burning
+    del sup  # crash
+    sup2 = Supervisor.resume(sc.strict3(), 1, sc.default_config(), **kw)
+    led = sup2.processor.ledger
+    assert led.slo.burn_rate() == pytest.approx(want_burn)
+    # Clocks are wiring, never pickled: resume re-pins them everywhere.
+    assert led.clock is clock
+    assert sup2.processor._guard._clock is clock
+    # Post-resume batches keep observing on the pinned timeline.
+    sup2.process([Record("k", sc.A, 2000, offset=3)])
+    assert led.records_committed == 4
+    assert led.slo.burn_rate() > 0
 
 
 # -- rendering / exemplars ----------------------------------------------------

@@ -349,7 +349,11 @@ def test_walk_kernel_lazy_parity_under_pressure():
     assert ref.walk_counters(st_r)["drain_hops"] > 0
 
 
+@pytest.mark.slow
 def test_scan_kernel_lazy_parity_under_pressure():
+    # Tier-2 (-m slow, ~11 s interpret): the walk-kernel variant above
+    # keeps kernel lazy-parity in tier-1 (ROADMAP tier-1 budget note,
+    # PR 13).
     from kafkastreams_cep_tpu.compiler.tables import lower
     from kafkastreams_cep_tpu.ops.scan_kernel import build_scan
 
@@ -567,7 +571,11 @@ def _run_proc(config, batches, K, **kw):
     return proc, out
 
 
+@pytest.mark.slow
 def test_processor_lazy_emission_order_parity():
+    # Tier-2 (-m slow, ~34 s): test_lazy_drain_matches_eager_jnp and
+    # the pressure-parity pair keep lazy-vs-eager coverage in tier-1
+    # (ROADMAP tier-1 budget note, PR 13).
     os.environ["CEP_WALK_KERNEL"] = "0"
     K = 4
     batches = _mk_batches(4, 64, K, 7)

@@ -36,6 +36,7 @@ def _fat_snapshot(tmp_path):
         checkpoint_path=str(tmp_path / "g.ckpt"), checkpoint_every=2,
         gc_interval=1, ingest=IngestPolicy(grace_ms=0),
         latency=LatencyLedger(slo=SLOTracker(threshold_s=1.0)),
+        overload_policy=True,
     )
     vals = [sc.A, sc.B, sc.C, sc.X, sc.A, sc.B, sc.C, sc.X]
     with fp.FAILPOINTS.session({"device.result": [2]}):

@@ -75,7 +75,11 @@ def run_both(pattern, cfg, events, n_scans=1):
     assert_state_equal(st_k, st_r)
 
 
+@pytest.mark.slow
 def test_stock_pattern_with_padding():
+    # Tier-2 (-m slow, ~21 s interpret): test_strict_contiguity_chain /
+    # test_typed_float_folds keep the scan path in tier-1 (ROADMAP
+    # tier-1 budget note, PR 13).
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
                                     "examples"))
@@ -102,7 +106,11 @@ def test_stock_pattern_with_padding():
     run_both(stock_demo.stock_pattern(), cfg, events)
 
 
+@pytest.mark.slow
 def test_kleene_any_branching_two_scans():
+    # Tier-2 (-m slow, ~45 s interpret) — the branching Kleene shape
+    # also runs in the engine-fuzz kleene suite (ROADMAP tier-1 budget
+    # note, PR 13).
     pattern = (
         Query()
         .select("a").where(lambda k, v, ts, st: v["x"] == 0)
@@ -141,7 +149,11 @@ def test_typed_float_folds():
     run_both(pattern, cfg, events_of(xs))
 
 
+@pytest.mark.slow
 def test_version_overflow_counted_identically():
+    # Tier-2 (-m slow, ~13 s interpret): overflow accounting stays in
+    # tier-1 via test_renorm's long-stream contract (ROADMAP tier-1
+    # budget note, PR 13).
     pattern = (
         Query()
         .select("a").where(lambda k, v, ts, st: v["x"] == 0)

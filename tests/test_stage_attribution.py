@@ -131,7 +131,11 @@ def test_walk_kernel_attribution_parity():
     assert int(np.asarray(st_r.slab.stage_hops).sum()) > 0
 
 
+@pytest.mark.slow
 def test_scan_kernel_attribution_parity():
+    # Tier-2 (-m slow, ~12 s interpret): the walk-kernel parity above
+    # keeps kernel attribution in tier-1 (ROADMAP tier-1 budget note,
+    # PR 13).
     from kafkastreams_cep_tpu.compiler.tables import lower
     from kafkastreams_cep_tpu.ops.scan_kernel import build_scan
 

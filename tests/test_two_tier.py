@@ -306,7 +306,11 @@ def test_walk_kernel_two_tier_parity_under_pressure():
     assert ref.counters(st_r)["slab_full_drops"] > 0, "drops must fire"
 
 
+@pytest.mark.slow
 def test_scan_kernel_two_tier_parity_under_pressure():
+    # Tier-2 (-m slow, ~12 s interpret): the walk-kernel variant above
+    # keeps kernel two-tier coverage in tier-1 (ROADMAP tier-1 budget
+    # note, PR 13).
     from kafkastreams_cep_tpu.compiler.tables import lower
     from kafkastreams_cep_tpu.ops.scan_kernel import build_scan
 
@@ -322,9 +326,14 @@ def test_scan_kernel_two_tier_parity_under_pressure():
     assert ref.hot_counters(st_r)["slab_demotions"] > 0
 
 
+@pytest.mark.slow
 def test_two_tier_vs_single_tier_engine_bit_exact():
     """The placement-only claim at engine level: same trace, same shapes,
-    hot window on vs off — emissions and drop counters bit-identical."""
+    hot window on vs off — emissions and drop counters bit-identical.
+
+    Tier-2 (``-m slow``, ~18 s): the walk/scan parity-under-pressure
+    pair above keeps the two-tier claim in tier-1 (ROADMAP tier-1
+    budget note, PR 13)."""
     K, T = 8, 48
     events = stock_events(K, T, 5)
     os.environ["CEP_WALK_KERNEL"] = "0"
